@@ -1,0 +1,41 @@
+"""sparkdl_tpu — Deep Learning Pipelines, rebuilt TPU-native.
+
+A from-scratch framework with the capabilities of
+``chubbyjiang/spark-deep-learning`` ("Deep Learning Pipelines for Apache
+Spark", python package ``sparkdl``), built idiomatically on JAX/XLA for TPU:
+Flax models resident in HBM, jit/pjit execution via PJRT, declarative
+sharding over device meshes (ICI/DCN collectives from XLA, not NCCL), an
+Arrow-columnar partitioned DataFrame engine, and Orbax checkpointing.
+
+Public surface mirrors the reference's ``sparkdl/__init__.py`` ``__all__``
+(SURVEY.md §2.1), with TPU-native payloads. Heavy submodules are imported
+lazily on attribute access so that ``import sparkdl_tpu`` stays cheap.
+"""
+
+from sparkdl_tpu.version import __version__
+
+# Grown as subsystems land; every name here must resolve (tested).
+_LAZY_EXPORTS = {
+    # image layer
+    "imageIO": ("sparkdl_tpu.image", "imageIO"),
+    "imageSchema": ("sparkdl_tpu.image", "imageSchema"),
+    "readImages": ("sparkdl_tpu.image", "readImages"),
+    "readImagesWithCustomFn": ("sparkdl_tpu.image", "readImagesWithCustomFn"),
+    # engine
+    "DataFrame": ("sparkdl_tpu.engine", "DataFrame"),
+}
+
+__all__ = ["__version__"] + sorted(_LAZY_EXPORTS)
+
+
+def __getattr__(name):
+    try:
+        module_name, attr = _LAZY_EXPORTS[name]
+    except KeyError:
+        raise AttributeError(f"module 'sparkdl_tpu' has no attribute {name!r}") from None
+    import importlib
+
+    module = importlib.import_module(module_name)
+    value = getattr(module, attr)
+    globals()[name] = value
+    return value

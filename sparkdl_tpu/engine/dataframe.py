@@ -1,0 +1,399 @@
+"""Partitioned, columnar DataFrame over Arrow record batches.
+
+This is the rebuild's replacement for the reference's L1 JVM data plane
+(Spark core/SQL + TensorFrames; SURVEY.md §1, §2.3). Design points, chosen
+for the TPU data path rather than translated from Spark:
+
+- **Columnar storage**: each partition is a ``pyarrow.RecordBatch``; image
+  bytes stay contiguous so host staging before ``device_put`` is zero-copy.
+- **Lazy plans**: transformations append ops to a plan; ``collect`` /
+  ``toArrow`` / transformer execution materialize partition-by-partition in
+  one pass (op fusion per partition, like Spark's pipelined narrow stages).
+- **Partition-parallel execution with retry**: a thread pool maps partitions
+  with bounded retry — the engine-level analog of Spark task retry
+  (SURVEY.md §5.3). Ops must be pure/idempotent, which every op built by
+  this framework is.
+- **No JVM, no shuffle**: the workloads this framework serves (per-row model
+  application, featurize, fit) are narrow; wide shuffles are out of scope,
+  matching the reference's actual usage of Spark.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as _futures
+import os
+import threading
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+
+
+class EngineConfig:
+    """Engine-wide knobs (no globals beyond this explicit, test-overridable one)."""
+
+    max_task_retries: int = 2
+    max_workers: int = max(2, (os.cpu_count() or 4) // 2)
+    # Test hook (SURVEY.md §5.3 fault injection): callable(partition_index,
+    # attempt) that may raise to simulate a task failure.
+    fault_injector: Optional[Callable[[int, int], None]] = None
+
+
+class TaskFailure(RuntimeError):
+    """A partition task failed after exhausting retries."""
+
+
+def _run_partition(index: int, batch: pa.RecordBatch,
+                   ops: Sequence[Callable[[pa.RecordBatch], pa.RecordBatch]]
+                   ) -> pa.RecordBatch:
+    attempts = EngineConfig.max_task_retries + 1
+    last_err: Optional[BaseException] = None
+    for attempt in range(attempts):
+        try:
+            if EngineConfig.fault_injector is not None:
+                EngineConfig.fault_injector(index, attempt)
+            out = batch
+            for op in ops:
+                out = op(out)
+            return out
+        except Exception as e:  # noqa: BLE001 - task boundary
+            last_err = e
+    raise TaskFailure(
+        f"partition {index} failed after {attempts} attempts: {last_err}"
+    ) from last_err
+
+
+def _as_record_batches(table: pa.Table, num_partitions: int) -> List[pa.RecordBatch]:
+    n = max(1, table.num_rows)
+    num_partitions = max(1, min(num_partitions, n))
+    rows_per = -(-n // num_partitions)  # ceil
+    out = []
+    for start in range(0, table.num_rows, rows_per):
+        chunk = table.slice(start, rows_per).combine_chunks()
+        out.extend(chunk.to_batches())
+    if not out:  # empty table: keep one empty batch so schema survives
+        out = table.to_batches() or [
+            pa.RecordBatch.from_arrays(
+                [pa.array([], type=f.type) for f in table.schema],
+                schema=table.schema)
+        ]
+    return out
+
+
+class DataFrame:
+    """Immutable, lazily-evaluated partitioned columnar frame."""
+
+    def __init__(self, partitions: List[pa.RecordBatch], schema: pa.Schema,
+                 ops: Optional[List[Callable]] = None):
+        self._partitions = partitions
+        self._schema = schema
+        self._ops = list(ops or [])
+        self._materialized: Optional[List[pa.RecordBatch]] = None
+        self._lock = threading.Lock()
+
+    # -- constructors --------------------------------------------------------
+
+    @classmethod
+    def fromArrow(cls, table: pa.Table, numPartitions: Optional[int] = None
+                  ) -> "DataFrame":
+        parts = _as_record_batches(table, numPartitions or EngineConfig.max_workers)
+        return cls(parts, table.schema)
+
+    @classmethod
+    def fromPandas(cls, pdf: pd.DataFrame, numPartitions: Optional[int] = None
+                   ) -> "DataFrame":
+        return cls.fromArrow(pa.Table.from_pandas(pdf, preserve_index=False),
+                             numPartitions)
+
+    @classmethod
+    def fromRows(cls, rows: List[Dict[str, Any]], schema: Optional[pa.Schema] = None,
+                 numPartitions: Optional[int] = None) -> "DataFrame":
+        if schema is not None:
+            table = pa.Table.from_pylist(rows, schema=schema)
+        else:
+            table = pa.Table.from_pylist(rows)
+        return cls.fromArrow(table, numPartitions)
+
+    @classmethod
+    def fromColumns(cls, columns: Dict[str, Any],
+                    numPartitions: Optional[int] = None) -> "DataFrame":
+        """Build from {name: numpy-or-list}; N-D arrays become FixedSizeList cols."""
+        arrays, fields = [], []
+        for name, values in columns.items():
+            arr = to_arrow_array(values)
+            arrays.append(arr)
+            fields.append(pa.field(name, arr.type))
+        table = pa.Table.from_arrays(arrays, schema=pa.schema(fields))
+        return cls.fromArrow(table, numPartitions)
+
+    # -- metadata ------------------------------------------------------------
+
+    @property
+    def schema(self) -> pa.Schema:
+        return self._schema
+
+    @property
+    def columns(self) -> List[str]:
+        return [f.name for f in self._schema]
+
+    @property
+    def numPartitions(self) -> int:
+        return len(self._partitions)
+
+    def __repr__(self) -> str:
+        cols = ", ".join(f"{f.name}: {f.type}" for f in self._schema)
+        return f"DataFrame[{cols}] ({self.numPartitions} partitions)"
+
+    # -- execution -----------------------------------------------------------
+
+    def _materialize(self) -> List[pa.RecordBatch]:
+        with self._lock:
+            if self._materialized is not None:
+                return self._materialized
+            if not self._ops:
+                self._materialized = self._partitions
+                return self._materialized
+            if len(self._partitions) == 1:
+                self._materialized = [_run_partition(0, self._partitions[0], self._ops)]
+                return self._materialized
+            with _futures.ThreadPoolExecutor(EngineConfig.max_workers) as pool:
+                futs = [pool.submit(_run_partition, i, b, self._ops)
+                        for i, b in enumerate(self._partitions)]
+                self._materialized = [f.result() for f in futs]
+            return self._materialized
+
+    def toArrow(self) -> pa.Table:
+        batches = self._materialize()
+        try:
+            return pa.Table.from_batches(batches, schema=self._schema)
+        except (pa.ArrowInvalid, pa.ArrowTypeError):
+            # Declared schema can be imprecise when a withColumn had no
+            # explicit outputType (type inferred at materialization); unify
+            # the materialized batch schemas, preferring non-null types.
+            unified = pa.unify_schemas([b.schema for b in batches],
+                                       promote_options="permissive")
+            casted = [b.cast(unified) for b in batches]
+            return pa.Table.from_batches(casted, schema=unified)
+
+    def toPandas(self) -> pd.DataFrame:
+        return self.toArrow().to_pandas()
+
+    def collect(self) -> List[Dict[str, Any]]:
+        return self.toArrow().to_pylist()
+
+    def count(self) -> int:
+        return sum(b.num_rows for b in self._materialize())
+
+    def isEmpty(self) -> bool:
+        return self.count() == 0
+
+    def show(self, n: int = 20) -> None:
+        print(self.limit(n).toPandas())
+
+    def foreachPartition(self, fn: Callable[[pa.RecordBatch], None]) -> None:
+        for batch in self._materialize():
+            fn(batch)
+
+    def partitionsIter(self) -> Iterable[pa.RecordBatch]:
+        """Iterate materialized partitions (streaming consumption order)."""
+        yield from self._materialize()
+
+    # -- transformations (lazy) ----------------------------------------------
+
+    def _with_op(self, op: Callable[[pa.RecordBatch], pa.RecordBatch],
+                 schema: pa.Schema) -> "DataFrame":
+        # Reuse already-materialized results (e.g. after cache()) so derived
+        # frames don't recompute the upstream op chain.
+        if self._materialized is not None and self._ops:
+            return DataFrame(self._materialized, schema, [op])
+        return DataFrame(self._partitions, schema, self._ops + [op])
+
+    def mapPartitions(self, fn: Callable[[pa.RecordBatch], pa.RecordBatch],
+                      schema: Optional[pa.Schema] = None) -> "DataFrame":
+        return self._with_op(fn, schema or self._schema)
+
+    def select(self, *cols: str) -> "DataFrame":
+        names = list(cols)
+        for name in names:
+            if name not in self.columns:
+                raise KeyError(f"No such column: {name!r}")
+        schema = pa.schema([self._schema.field(n) for n in names])
+
+        def op(batch: pa.RecordBatch) -> pa.RecordBatch:
+            cols = [batch.column(batch.schema.get_field_index(n)) for n in names]
+            # Use the batch's actual types, not the declared schema: an
+            # upstream withColumn without explicit outputType only learns its
+            # type at materialization.
+            actual = pa.schema([pa.field(n, c.type) for n, c in zip(names, cols)])
+            return pa.RecordBatch.from_arrays(cols, schema=actual)
+
+        return self._with_op(op, schema)
+
+    def drop(self, *cols: str) -> "DataFrame":
+        keep = [c for c in self.columns if c not in cols]
+        return self.select(*keep)
+
+    def withColumnRenamed(self, existing: str, new: str) -> "DataFrame":
+        if existing not in self.columns:
+            raise KeyError(f"No such column: {existing!r}")
+        schema = pa.schema([
+            pa.field(new, f.type) if f.name == existing else f
+            for f in self._schema])
+
+        def op(batch: pa.RecordBatch) -> pa.RecordBatch:
+            actual = pa.schema([
+                pa.field(new, c.type) if n == existing else pa.field(n, c.type)
+                for n, c in zip(batch.schema.names, batch.columns)])
+            return pa.RecordBatch.from_arrays(list(batch.columns), schema=actual)
+
+        return self._with_op(op, schema)
+
+    def withColumn(self, name: str, fn: Callable, inputCols: Sequence[str],
+                   outputType: Optional[pa.DataType] = None) -> "DataFrame":
+        """Row-wise UDF column: ``fn(*input_values) -> value``.
+
+        The engine analog of a Spark Python UDF ``withColumn``. For
+        vectorized device work use :meth:`withColumnBatch`.
+        """
+        out_type = outputType
+
+        def op(batch: pa.RecordBatch) -> pa.RecordBatch:
+            inputs = [batch.column(batch.schema.get_field_index(c)).to_pylist()
+                      for c in inputCols]
+            values = [fn(*row) for row in zip(*inputs)] if inputs else []
+            if out_type is not None:
+                arr = pa.array(values, type=out_type)
+            else:
+                arr = pa.array(values)
+            return _set_column(batch, name, arr)
+
+        schema = _schema_with(self._schema, name,
+                              out_type if out_type is not None else pa.null())
+        return self._with_op(op, schema)
+
+    def withColumnBatch(self, name: str, fn: Callable[[pa.RecordBatch], pa.Array],
+                        outputType: Optional[pa.DataType] = None) -> "DataFrame":
+        """Vectorized column: ``fn(record_batch) -> pa.Array`` (len == num_rows).
+
+        This is the hook model transformers use: fn stages the whole
+        partition to the device in one transfer and returns a columnar
+        result — the TensorFrames ``map_blocks`` analog (SURVEY.md §3.2).
+        """
+        out_type = outputType
+
+        def op(batch: pa.RecordBatch) -> pa.RecordBatch:
+            arr = fn(batch)
+            if not isinstance(arr, (pa.Array, pa.ChunkedArray)):
+                arr = pa.array(arr, type=out_type)
+            elif out_type is not None and arr.type != out_type:
+                arr = arr.cast(out_type)
+            if len(arr) != batch.num_rows:
+                raise ValueError(
+                    f"withColumnBatch fn returned {len(arr)} values for "
+                    f"{batch.num_rows} rows")
+            return _set_column(batch, name, arr)
+
+        schema = _schema_with(self._schema, name,
+                              out_type if out_type is not None else pa.null())
+        return self._with_op(op, schema)
+
+    def filter(self, predicate: Callable, inputCols: Sequence[str]) -> "DataFrame":
+        def op(batch: pa.RecordBatch) -> pa.RecordBatch:
+            inputs = [batch.column(batch.schema.get_field_index(c)).to_pylist()
+                      for c in inputCols]
+            mask = pa.array([bool(predicate(*row)) for row in zip(*inputs)],
+                            type=pa.bool_())
+            return batch.filter(mask)
+
+        return self._with_op(op, self._schema)
+
+    def dropna(self, subset: Optional[Sequence[str]] = None) -> "DataFrame":
+        cols = list(subset or self.columns)
+
+        def op(batch: pa.RecordBatch) -> pa.RecordBatch:
+            mask = np.ones(batch.num_rows, dtype=bool)
+            for c in cols:
+                arr = batch.column(batch.schema.get_field_index(c))
+                mask &= np.asarray(arr.is_valid())
+            return batch.filter(pa.array(mask))
+
+        return self._with_op(op, self._schema)
+
+    # -- materializing transformations ---------------------------------------
+
+    def repartition(self, numPartitions: int) -> "DataFrame":
+        return DataFrame.fromArrow(self.toArrow(), numPartitions)
+
+    def limit(self, n: int) -> "DataFrame":
+        return DataFrame.fromArrow(self.toArrow().slice(0, n),
+                                   numPartitions=1)
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        table = pa.concat_tables([self.toArrow(), other.toArrow()])
+        return DataFrame.fromArrow(
+            table, numPartitions=self.numPartitions + other.numPartitions)
+
+    def cache(self) -> "DataFrame":
+        self._materialize()
+        return self
+
+
+# ---------------------------------------------------------------------------
+# Arrow helpers
+# ---------------------------------------------------------------------------
+
+def _schema_with(schema: pa.Schema, name: str, dtype: pa.DataType) -> pa.Schema:
+    fields = [f for f in schema if f.name != name]
+    return pa.schema(fields + [pa.field(name, dtype)])
+
+
+def _set_column(batch: pa.RecordBatch, name: str, arr) -> pa.RecordBatch:
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    names = batch.schema.names
+    if name in names:
+        idx = names.index(name)
+        cols = list(batch.columns)
+        cols[idx] = arr
+        fields = [pa.field(n, cols[i].type) for i, n in enumerate(names)]
+        return pa.RecordBatch.from_arrays(cols, schema=pa.schema(fields))
+    cols = list(batch.columns) + [arr]
+    fields = list(batch.schema) + [pa.field(name, arr.type)]
+    return pa.RecordBatch.from_arrays(cols, schema=pa.schema(fields))
+
+
+def to_arrow_array(values: Any) -> pa.Array:
+    """Convert list/numpy to Arrow; N-D numpy → FixedSizeList of flattened rows."""
+    if isinstance(values, pa.Array):
+        return values
+    if isinstance(values, np.ndarray) and values.ndim > 1:
+        n = values.shape[0]
+        flat = np.ascontiguousarray(values).reshape(n, -1)
+        return fixed_size_list_array(flat)
+    return pa.array(values)
+
+
+def fixed_size_list_array(flat2d: np.ndarray) -> pa.FixedSizeListArray:
+    """(N, K) numpy → Arrow FixedSizeList<item: dtype>[K], zero-copy values."""
+    n, k = flat2d.shape
+    values = pa.array(np.ascontiguousarray(flat2d).reshape(-1))
+    return pa.FixedSizeListArray.from_arrays(values, k)
+
+
+def column_to_numpy(arr, dtype=None) -> np.ndarray:
+    """Arrow column (numeric / [FixedSize]List thereof) → numpy (N, ...) array."""
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    if pa.types.is_fixed_size_list(arr.type):
+        k = arr.type.list_size
+        values = arr.values.to_numpy(zero_copy_only=False)
+        out = values.reshape(len(arr), k)
+    elif pa.types.is_list(arr.type) or pa.types.is_large_list(arr.type):
+        rows = arr.to_pylist()
+        out = np.asarray(rows)
+    else:
+        out = arr.to_numpy(zero_copy_only=False)
+    if dtype is not None:
+        out = np.asarray(out, dtype=dtype)
+    return out

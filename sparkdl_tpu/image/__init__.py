@@ -1,0 +1,21 @@
+"""Image schema + host-side image I/O (reference L3 data layer)."""
+
+from sparkdl_tpu.image import imageIO
+from sparkdl_tpu.image.imageIO import (
+    imageSchema,
+    imageFields,
+    imageArrayToStruct,
+    imageStructToArray,
+    readImages,
+    readImagesWithCustomFn,
+)
+
+__all__ = [
+    "imageIO",
+    "imageSchema",
+    "imageFields",
+    "imageArrayToStruct",
+    "imageStructToArray",
+    "readImages",
+    "readImagesWithCustomFn",
+]

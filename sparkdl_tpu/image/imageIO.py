@@ -1,0 +1,263 @@
+"""Image struct schema + codecs — the L3 data contract of the framework.
+
+Parity: upstream ``python/sparkdl/image/imageIO.py`` (SURVEY.md §2.1; the
+reference mount was empty this round so cites are package-level). The
+reference defined the image-struct schema aligned with Spark 2.3+
+``ImageSchema`` — fields ``(origin, height, width, nChannels, mode, data)``
+with OpenCV-style mode codes — plus numpy↔struct codecs, PIL decode, and
+``readImagesWithCustomFn``. This rebuild keeps the exact field contract
+(so reference users find the same schema) but stores columns as **Arrow**
+struct arrays: binary image bytes stay contiguous and zero-copy between the
+engine's partitions and host staging buffers feeding TPU HBM.
+
+Decode fast path: the native C++ loader (libjpeg/libpng + fused
+resize/normalize, ``sparkdl_tpu/native``) when built; PIL fallback always
+works.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import namedtuple
+from typing import Callable, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+# ---------------------------------------------------------------------------
+# Schema: field-for-field the Spark ImageSchema struct the reference used.
+# ---------------------------------------------------------------------------
+
+imageSchema = pa.struct([
+    pa.field("origin", pa.string()),
+    pa.field("height", pa.int32()),
+    pa.field("width", pa.int32()),
+    pa.field("nChannels", pa.int32()),
+    pa.field("mode", pa.int32()),
+    pa.field("data", pa.binary()),
+])
+
+imageFields: List[str] = [f.name for f in imageSchema]
+
+ImageType = namedtuple("ImageType", ["name", "ocvType", "nChannels", "dtype"])
+
+# OpenCV type codes, as used by Spark's ImageSchema (uint8) and extended by
+# the reference to float32 images.
+SUPPORTED_OCV_TYPES = (
+    ImageType("CV_8UC1", 0, 1, "uint8"),
+    ImageType("CV_32FC1", 5, 1, "float32"),
+    ImageType("CV_8UC3", 16, 3, "uint8"),
+    ImageType("CV_32FC3", 21, 3, "float32"),
+    ImageType("CV_8UC4", 24, 4, "uint8"),
+    ImageType("CV_32FC4", 29, 4, "float32"),
+)
+
+_OCV_BY_NAME = {t.name: t for t in SUPPORTED_OCV_TYPES}
+_OCV_BY_CODE = {t.ocvType: t for t in SUPPORTED_OCV_TYPES}
+
+
+def imageTypeByName(name: str) -> ImageType:
+    try:
+        return _OCV_BY_NAME[name]
+    except KeyError:
+        raise ValueError(f"Unsupported image mode name {name!r}; "
+                         f"supported: {sorted(_OCV_BY_NAME)}") from None
+
+
+def imageTypeByCode(code: int) -> ImageType:
+    try:
+        return _OCV_BY_CODE[int(code)]
+    except KeyError:
+        raise ValueError(f"Unsupported image mode code {code}; "
+                         f"supported: {sorted(_OCV_BY_CODE)}") from None
+
+
+def imageTypeForArray(array: np.ndarray) -> ImageType:
+    if array.ndim != 3:
+        raise ValueError(f"Image array must be HWC (3-D), got shape {array.shape}")
+    channels = array.shape[2]
+    if array.dtype == np.uint8:
+        kind = "CV_8UC"
+    elif array.dtype == np.float32:
+        kind = "CV_32FC"
+    else:
+        raise ValueError(f"Unsupported image array dtype {array.dtype}; "
+                         "use uint8 or float32")
+    return imageTypeByName(f"{kind}{channels}")
+
+
+# ---------------------------------------------------------------------------
+# numpy <-> struct codecs
+# ---------------------------------------------------------------------------
+
+def imageArrayToStruct(imgArray: np.ndarray, origin: str = "") -> dict:
+    """Encode an HWC numpy array as an image-struct dict (schema above)."""
+    if imgArray.ndim == 2:
+        imgArray = imgArray[:, :, None]
+    imgArray = np.ascontiguousarray(imgArray)
+    imType = imageTypeForArray(imgArray)
+    height, width, nChannels = imgArray.shape
+    return {
+        "origin": origin,
+        "height": int(height),
+        "width": int(width),
+        "nChannels": int(nChannels),
+        "mode": imType.ocvType,
+        "data": imgArray.tobytes(),
+    }
+
+
+def imageStructToArray(imageRow) -> np.ndarray:
+    """Decode an image-struct (dict or Arrow struct scalar) to HWC numpy."""
+    if isinstance(imageRow, pa.StructScalar):
+        imageRow = imageRow.as_py()
+    imType = imageTypeByCode(imageRow["mode"])
+    shape = (imageRow["height"], imageRow["width"], imageRow["nChannels"])
+    return np.frombuffer(imageRow["data"], dtype=imType.dtype).reshape(shape)
+
+
+def imageStructsToBatchArray(structs: Sequence[dict],
+                             target_size: Optional[Tuple[int, int]] = None,
+                             dtype: str = "float32") -> np.ndarray:
+    """Decode many image structs to one NHWC batch, resizing if needed.
+
+    This is the host-side staging step that feeds ``device_put``: output is a
+    single contiguous NHWC array so transfer to HBM is one DMA.
+    """
+    arrays = []
+    for s in structs:
+        arr = imageStructToArray(s)
+        if target_size is not None and arr.shape[:2] != tuple(target_size):
+            arr = resizeImageArray(arr, target_size)
+        arrays.append(np.asarray(arr, dtype=dtype))
+    return np.stack(arrays) if arrays else np.zeros((0,), dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# Decode / resize (native fast path, PIL fallback)
+# ---------------------------------------------------------------------------
+
+def _pil_decode(data_or_path, target_size=None) -> Optional[np.ndarray]:
+    from io import BytesIO
+    from PIL import Image
+
+    try:
+        if isinstance(data_or_path, (bytes, bytearray)):
+            img = Image.open(BytesIO(data_or_path))
+        else:
+            img = Image.open(data_or_path)
+        if img.mode not in ("L", "RGB", "RGBA"):
+            img = img.convert("RGB")
+        if target_size is not None:
+            # PIL size is (W, H); target_size is (H, W) like the model spec.
+            img = img.resize((target_size[1], target_size[0]), Image.BILINEAR)
+        return np.asarray(img)
+    except Exception:
+        return None
+
+
+def decodeImageBytes(data: bytes, target_size=None) -> Optional[np.ndarray]:
+    """Decode compressed image bytes → HWC uint8 array (None on failure)."""
+    from sparkdl_tpu.native import loader as native_loader
+
+    if native_loader.available():
+        arr = native_loader.decode(data, target_size=target_size)
+        if arr is not None:
+            return arr
+    return _pil_decode(data, target_size=target_size)
+
+
+def stripFileScheme(uri: str) -> str:
+    """Normalize 'file://<path>' / 'file:<path>' URIs (both emitted by Spark
+    and by this package's readers) to a plain filesystem path."""
+    if uri.startswith("file://"):
+        return uri[7:]
+    if uri.startswith("file:"):
+        return uri[5:]
+    return uri
+
+
+def decodeImageFile(path: str, target_size=None) -> Optional[np.ndarray]:
+    """Decode an image file URI → HWC uint8 array (None on failure)."""
+    path = stripFileScheme(path)
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError:
+        return None
+    return decodeImageBytes(data, target_size=target_size)
+
+
+def resizeImageArray(arr: np.ndarray, target_size: Tuple[int, int]) -> np.ndarray:
+    """Bilinear-resize an HWC array to (H, W). Host-side, numpy/PIL only."""
+    from PIL import Image
+
+    th, tw = target_size
+    if arr.shape[:2] == (th, tw):
+        return arr
+    in_dtype = arr.dtype
+    if in_dtype == np.uint8:
+        img = Image.fromarray(arr.squeeze(-1) if arr.shape[2] == 1 else arr)
+        out = np.asarray(img.resize((tw, th), Image.BILINEAR))
+        if out.ndim == 2:
+            out = out[:, :, None]
+        return out
+    # float path: resize channel-planes via PIL 'F' mode
+    planes = [
+        np.asarray(Image.fromarray(arr[:, :, c], mode="F").resize((tw, th), Image.BILINEAR))
+        for c in range(arr.shape[2])
+    ]
+    return np.stack(planes, axis=-1).astype(in_dtype)
+
+
+# ---------------------------------------------------------------------------
+# DataFrame readers (parity: readImagesWithCustomFn / readImages)
+# ---------------------------------------------------------------------------
+
+_IMAGE_EXTENSIONS = (".jpg", ".jpeg", ".png", ".gif", ".bmp")
+
+
+def listImageFiles(path: str) -> List[str]:
+    path = stripFileScheme(path)
+    if os.path.isfile(path):
+        return [path]
+    found = []
+    for root, _dirs, files in os.walk(path):
+        for fname in sorted(files):
+            if fname.lower().endswith(_IMAGE_EXTENSIONS):
+                found.append(os.path.join(root, fname))
+    return sorted(found)
+
+
+def readImagesWithCustomFn(path: str, decode_f: Callable[[bytes], Optional[np.ndarray]],
+                           numPartition: Optional[int] = None):
+    """Read images under ``path`` with a custom decode fn → image DataFrame.
+
+    Parity: upstream ``imageIO.readImagesWithCustomFn``. Returns an engine
+    DataFrame with a single ``image`` struct column (plus ``filePath``);
+    undecodable files yield null image structs, as the reference did.
+    """
+    from sparkdl_tpu.engine import dataframe as edf  # lazy: avoid cycle
+
+    files = listImageFiles(path)
+
+    def load(fpath: str):
+        try:
+            with open(fpath, "rb") as f:
+                raw = f.read()
+        except OSError:
+            return None
+        arr = decode_f(raw)
+        if arr is None:
+            return None
+        return imageArrayToStruct(np.asarray(arr), origin="file:" + fpath)
+
+    rows = [{"filePath": "file:" + f, "image": load(f)} for f in files]
+    schema = pa.schema([pa.field("filePath", pa.string()),
+                        pa.field("image", imageSchema)])
+    return edf.DataFrame.fromRows(rows, schema=schema, numPartitions=numPartition)
+
+
+def readImages(path: str, numPartition: Optional[int] = None):
+    """Read images with the default decoder (native fast path / PIL)."""
+    return readImagesWithCustomFn(path, decodeImageBytes, numPartition)

@@ -1,0 +1,13 @@
+"""Native (C++) host-side components.
+
+The reference's native substrate lived in its dependencies (TF C++ executor,
+TensorFrames JNI, NCCL — SURVEY.md §2.3). The TPU rebuild's device-side
+native layer is libtpu/XLA via PJRT; this package holds the *host-side*
+native pieces we own: the image decode/resize data-loader
+(libjpeg/libpng C++, see ``image_loader.cc``), bound via ctypes with a pure
+PIL fallback so the framework works before/without the build step.
+"""
+
+from sparkdl_tpu.native import loader
+
+__all__ = ["loader"]

@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Build the native image loader (libjpeg + libpng, no other deps).
+set -euo pipefail
+cd "$(dirname "$0")"
+g++ -O3 -march=native -fPIC -shared -std=c++17 \
+    image_loader.cc -o libsparkdl_image.so \
+    -ljpeg -lpng -lpthread
+echo "built $(pwd)/libsparkdl_image.so"

@@ -1,0 +1,362 @@
+// Native host-side image data-loader for sparkdl_tpu.
+//
+// Role: the hot host path feeding TPU HBM. SURVEY.md §7 ranks host JPEG
+// decode as the #2 hard part (MXU starvation); this replaces the reference's
+// JVM-side decode (java.awt BufferedImage in ImageUtils.scala, SURVEY.md
+// §2.2) and Python PIL with a threaded C++ decode+resize:
+//   - libjpeg with DCT scaling (decode at 1/2, 1/4, 1/8 when the target is
+//     much smaller than the source — most of the win for featurize inputs),
+//   - libpng (palette/16-bit/alpha normalized to 8-bit),
+//   - fused bilinear resize to the model's fixed input size,
+//   - batch API decoding N blobs on a thread pool into ONE contiguous NHWC
+//     uint8 buffer, so staging to the device is a single DMA.
+//
+// C ABI (ctypes-bound in loader.py):
+//   int sdl_probe(const uint8_t* data, size_t len, int* h, int* w, int* c);
+//   int sdl_decode(const uint8_t* data, size_t len, int th, int tw,
+//                  uint8_t* out, int* h, int* w, int* c);
+//   int sdl_decode_batch(const char** ptrs, const size_t* lens, int n,
+//                        int th, int tw, uint8_t* out, int* status,
+//                        int num_threads);
+// All return 0 on success; sdl_decode_batch returns the failure count.
+
+#include <csetjmp>
+#include <cstdint>
+#include <cstring>
+#include <algorithm>
+#include <atomic>
+#include <thread>
+#include <vector>
+
+#include <jpeglib.h>
+#include <png.h>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Bilinear resize, interleaved uint8, C channels.
+// ---------------------------------------------------------------------------
+void resize_bilinear(const uint8_t* src, int sh, int sw, int c,
+                     uint8_t* dst, int dh, int dw) {
+  if (sh == dh && sw == dw) {
+    std::memcpy(dst, src, static_cast<size_t>(sh) * sw * c);
+    return;
+  }
+  const float sy = static_cast<float>(sh) / dh;
+  const float sx = static_cast<float>(sw) / dw;
+  for (int y = 0; y < dh; ++y) {
+    // Pixel-center sampling (align with PIL's convention).
+    float fy = (y + 0.5f) * sy - 0.5f;
+    fy = std::max(0.0f, std::min(fy, static_cast<float>(sh - 1)));
+    const int y0 = static_cast<int>(fy);
+    const int y1 = std::min(y0 + 1, sh - 1);
+    const float wy = fy - y0;
+    for (int x = 0; x < dw; ++x) {
+      float fx = (x + 0.5f) * sx - 0.5f;
+      fx = std::max(0.0f, std::min(fx, static_cast<float>(sw - 1)));
+      const int x0 = static_cast<int>(fx);
+      const int x1 = std::min(x0 + 1, sw - 1);
+      const float wx = fx - x0;
+      const uint8_t* p00 = src + (static_cast<size_t>(y0) * sw + x0) * c;
+      const uint8_t* p01 = src + (static_cast<size_t>(y0) * sw + x1) * c;
+      const uint8_t* p10 = src + (static_cast<size_t>(y1) * sw + x0) * c;
+      const uint8_t* p11 = src + (static_cast<size_t>(y1) * sw + x1) * c;
+      uint8_t* q = dst + (static_cast<size_t>(y) * dw + x) * c;
+      for (int k = 0; k < c; ++k) {
+        const float top = p00[k] + (p01[k] - p00[k]) * wx;
+        const float bot = p10[k] + (p11[k] - p10[k]) * wx;
+        q[k] = static_cast<uint8_t>(top + (bot - top) * wy + 0.5f);
+      }
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// JPEG
+// ---------------------------------------------------------------------------
+struct JpegErr {
+  jpeg_error_mgr mgr;
+  std::jmp_buf jump;
+};
+
+void jpeg_err_exit(j_common_ptr cinfo) {
+  JpegErr* err = reinterpret_cast<JpegErr*>(cinfo->err);
+  std::longjmp(err->jump, 1);
+}
+
+bool is_jpeg(const uint8_t* data, size_t len) {
+  return len >= 3 && data[0] == 0xFF && data[1] == 0xD8 && data[2] == 0xFF;
+}
+
+bool is_png(const uint8_t* data, size_t len) {
+  static const uint8_t sig[8] = {0x89, 'P', 'N', 'G', 0x0D, 0x0A, 0x1A, 0x0A};
+  return len >= 8 && std::memcmp(data, sig, 8) == 0;
+}
+
+// Decode JPEG into `pixels` (interleaved). Chooses libjpeg DCT scaling so the
+// decoded size is the smallest power-of-two scale still >= target (when a
+// target is given). Returns false on corrupt input.
+bool decode_jpeg(const uint8_t* data, size_t len, int target_h, int target_w,
+                 std::vector<uint8_t>* pixels, int* h, int* w, int* c) {
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = jpeg_err_exit;
+  if (setjmp(jerr.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(data), len);
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  cinfo.out_color_space =
+      cinfo.num_components == 1 ? JCS_GRAYSCALE : JCS_RGB;
+  if (target_h > 0 && target_w > 0) {
+    // Largest denom in {1,2,4,8} with scaled dims still >= target.
+    int denom = 1;
+    while (denom < 8 &&
+           static_cast<int>(cinfo.image_height) / (denom * 2) >= target_h &&
+           static_cast<int>(cinfo.image_width) / (denom * 2) >= target_w) {
+      denom *= 2;
+    }
+    cinfo.scale_num = 1;
+    cinfo.scale_denom = denom;
+  }
+  jpeg_start_decompress(&cinfo);
+  *h = cinfo.output_height;
+  *w = cinfo.output_width;
+  *c = cinfo.output_components;
+  pixels->resize(static_cast<size_t>(*h) * *w * *c);
+  const size_t stride = static_cast<size_t>(*w) * *c;
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t* row = pixels->data() + cinfo.output_scanline * stride;
+    JSAMPROW rows[1] = {row};
+    jpeg_read_scanlines(&cinfo, rows, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+bool probe_jpeg(const uint8_t* data, size_t len, int* h, int* w, int* c) {
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = jpeg_err_exit;
+  if (setjmp(jerr.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(data), len);
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  *h = cinfo.image_height;
+  *w = cinfo.image_width;
+  *c = cinfo.num_components == 1 ? 1 : 3;
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// PNG
+// ---------------------------------------------------------------------------
+struct PngReadState {
+  const uint8_t* data;
+  size_t len;
+  size_t pos;
+};
+
+void png_read_fn(png_structp png, png_bytep out, png_size_t count) {
+  PngReadState* st = static_cast<PngReadState*>(png_get_io_ptr(png));
+  if (st->pos + count > st->len) {
+    png_error(png, "read past end");
+  }
+  std::memcpy(out, st->data + st->pos, count);
+  st->pos += count;
+}
+
+bool decode_png(const uint8_t* data, size_t len, std::vector<uint8_t>* pixels,
+                int* h, int* w, int* c) {
+  png_structp png =
+      png_create_read_struct(PNG_LIBPNG_VER_STRING, nullptr, nullptr, nullptr);
+  if (!png) return false;
+  png_infop info = png_create_info_struct(png);
+  if (!info) {
+    png_destroy_read_struct(&png, nullptr, nullptr);
+    return false;
+  }
+  if (setjmp(png_jmpbuf(png))) {
+    png_destroy_read_struct(&png, &info, nullptr);
+    return false;
+  }
+  PngReadState st{data, len, 0};
+  png_set_read_fn(png, &st, png_read_fn);
+  png_read_info(png, info);
+
+  png_uint_32 width = 0, height = 0;
+  int bit_depth = 0, color_type = 0;
+  png_get_IHDR(png, info, &width, &height, &bit_depth, &color_type, nullptr,
+               nullptr, nullptr);
+  // Normalize to 8-bit gray / RGB / RGBA.
+  if (color_type == PNG_COLOR_TYPE_PALETTE) png_set_palette_to_rgb(png);
+  if (color_type == PNG_COLOR_TYPE_GRAY && bit_depth < 8)
+    png_set_expand_gray_1_2_4_to_8(png);
+  if (png_get_valid(png, info, PNG_INFO_tRNS)) png_set_tRNS_to_alpha(png);
+  if (bit_depth == 16) png_set_strip_16(png);
+  png_read_update_info(png, info);
+
+  *h = static_cast<int>(height);
+  *w = static_cast<int>(width);
+  *c = static_cast<int>(png_get_channels(png, info));
+  const size_t stride = png_get_rowbytes(png, info);
+  pixels->resize(stride * height);
+  std::vector<png_bytep> rows(height);
+  for (png_uint_32 y = 0; y < height; ++y) {
+    rows[y] = pixels->data() + y * stride;
+  }
+  png_read_image(png, rows.data());
+  png_destroy_read_struct(&png, &info, nullptr);
+  return true;
+}
+
+bool probe_png(const uint8_t* data, size_t len, int* h, int* w, int* c) {
+  png_structp png =
+      png_create_read_struct(PNG_LIBPNG_VER_STRING, nullptr, nullptr, nullptr);
+  if (!png) return false;
+  png_infop info = png_create_info_struct(png);
+  if (!info) {
+    png_destroy_read_struct(&png, nullptr, nullptr);
+    return false;
+  }
+  if (setjmp(png_jmpbuf(png))) {
+    png_destroy_read_struct(&png, &info, nullptr);
+    return false;
+  }
+  PngReadState st{data, len, 0};
+  png_set_read_fn(png, &st, png_read_fn);
+  png_read_info(png, info);
+  png_uint_32 width = 0, height = 0;
+  int bit_depth = 0, color_type = 0;
+  png_get_IHDR(png, info, &width, &height, &bit_depth, &color_type, nullptr,
+               nullptr, nullptr);
+  *h = static_cast<int>(height);
+  *w = static_cast<int>(width);
+  switch (color_type) {
+    case PNG_COLOR_TYPE_GRAY: *c = 1; break;
+    case PNG_COLOR_TYPE_GRAY_ALPHA: *c = 2; break;
+    case PNG_COLOR_TYPE_RGB_ALPHA: *c = 4; break;
+    default: *c = png_get_valid(png, info, PNG_INFO_tRNS) ? 4 : 3; break;
+  }
+  png_destroy_read_struct(&png, &info, nullptr);
+  return true;
+}
+
+// Channel conversion helper: any (1,2,3,4)-channel interleaved → 3ch RGB.
+void to_rgb(const std::vector<uint8_t>& in, int h, int w, int c,
+            std::vector<uint8_t>* out) {
+  out->resize(static_cast<size_t>(h) * w * 3);
+  const size_t n = static_cast<size_t>(h) * w;
+  for (size_t i = 0; i < n; ++i) {
+    const uint8_t* p = in.data() + i * c;
+    uint8_t* q = out->data() + i * 3;
+    switch (c) {
+      case 1: q[0] = q[1] = q[2] = p[0]; break;
+      case 2: q[0] = q[1] = q[2] = p[0]; break;  // gray+alpha: drop alpha
+      case 3: q[0] = p[0]; q[1] = p[1]; q[2] = p[2]; break;
+      default: q[0] = p[0]; q[1] = p[1]; q[2] = p[2]; break;  // drop alpha
+    }
+  }
+}
+
+bool decode_any(const uint8_t* data, size_t len, int target_h, int target_w,
+                std::vector<uint8_t>* pixels, int* h, int* w, int* c) {
+  if (is_jpeg(data, len)) {
+    return decode_jpeg(data, len, target_h, target_w, pixels, h, w, c);
+  }
+  if (is_png(data, len)) {
+    return decode_png(data, len, pixels, h, w, c);
+  }
+  return false;
+}
+
+}  // namespace
+
+extern "C" {
+
+int sdl_probe(const uint8_t* data, size_t len, int* h, int* w, int* c) {
+  if (is_jpeg(data, len)) return probe_jpeg(data, len, h, w, c) ? 0 : 1;
+  if (is_png(data, len)) return probe_png(data, len, h, w, c) ? 0 : 1;
+  return 1;
+}
+
+// Decode + resize to (th, tw) preserving the image's own channel count
+// (as reported by sdl_probe). `out` must hold th*tw*C bytes.
+int sdl_decode(const uint8_t* data, size_t len, int th, int tw, uint8_t* out,
+               int* h, int* w, int* c) {
+  std::vector<uint8_t> pixels;
+  int sh = 0, sw = 0, sc = 0;
+  if (!decode_any(data, len, th, tw, &pixels, &sh, &sw, &sc)) return 1;
+  if (th <= 0 || tw <= 0) {
+    th = sh;
+    tw = sw;
+  }
+  resize_bilinear(pixels.data(), sh, sw, sc, out, th, tw);
+  *h = th;
+  *w = tw;
+  *c = sc;
+  return 0;
+}
+
+// Batch decode into one contiguous NHWC uint8 buffer, forced to 3-channel
+// RGB (model input convention). Threaded. Returns number of failures;
+// status[i] != 0 marks blob i as failed.
+int sdl_decode_batch(const char** ptrs, const size_t* lens, int n, int th,
+                     int tw, uint8_t* out, int* status, int num_threads) {
+  if (n <= 0) return 0;
+  if (num_threads <= 0) {
+    num_threads = static_cast<int>(std::thread::hardware_concurrency());
+    if (num_threads <= 0) num_threads = 4;
+  }
+  num_threads = std::min(num_threads, n);
+  const size_t img_bytes = static_cast<size_t>(th) * tw * 3;
+  std::atomic<int> next(0);
+  std::atomic<int> failures(0);
+
+  auto worker = [&]() {
+    std::vector<uint8_t> pixels, rgb, resized;
+    for (;;) {
+      const int i = next.fetch_add(1);
+      if (i >= n) break;
+      int sh = 0, sw = 0, sc = 0;
+      const uint8_t* blob = reinterpret_cast<const uint8_t*>(ptrs[i]);
+      if (!decode_any(blob, lens[i], th, tw, &pixels, &sh, &sw, &sc)) {
+        status[i] = 1;
+        failures.fetch_add(1);
+        std::memset(out + static_cast<size_t>(i) * img_bytes, 0, img_bytes);
+        continue;
+      }
+      const std::vector<uint8_t>* src = &pixels;
+      if (sc != 3) {
+        to_rgb(pixels, sh, sw, sc, &rgb);
+        src = &rgb;
+      }
+      resize_bilinear(src->data(), sh, sw, 3,
+                      out + static_cast<size_t>(i) * img_bytes, th, tw);
+      status[i] = 0;
+    }
+  };
+
+  std::vector<std::thread> threads;
+  threads.reserve(num_threads);
+  for (int t = 0; t < num_threads; ++t) threads.emplace_back(worker);
+  for (auto& t : threads) t.join();
+  return failures.load();
+}
+
+}  // extern "C"

@@ -1,0 +1,138 @@
+"""ctypes binding for the native C++ image loader (``libsparkdl_image.so``).
+
+Falls back cleanly when the shared library has not been built — callers
+check :func:`available` and use the PIL path otherwise. Build with
+``sparkdl_tpu/native/build.sh`` (g++ + libjpeg + libpng, no extra deps).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_LIB_NAME = "libsparkdl_image.so"
+_lib = None
+_lib_lock = threading.Lock()
+_load_attempted = False
+
+
+def _library_path() -> str:
+    return os.path.join(os.path.dirname(__file__), _LIB_NAME)
+
+
+def _try_build() -> bool:
+    """Best-effort one-shot build of the .so from the in-tree C++ source.
+
+    Disable with SPARKDL_TPU_NO_NATIVE_BUILD=1 (tests of the PIL fallback,
+    or environments without g++/libjpeg-dev).
+    """
+    if os.environ.get("SPARKDL_TPU_NO_NATIVE_BUILD"):
+        return False
+    script = os.path.join(os.path.dirname(__file__), "build.sh")
+    if not os.path.exists(script):
+        return False
+    import subprocess
+
+    try:
+        subprocess.run(["bash", script], check=True, capture_output=True,
+                       timeout=120)
+    except Exception:
+        return False
+    return os.path.exists(_library_path())
+
+
+def _load():
+    global _lib, _load_attempted
+    with _lib_lock:
+        if _load_attempted:
+            return _lib
+        _load_attempted = True
+        path = _library_path()
+        if not os.path.exists(path):
+            if not _try_build():
+                return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            return None
+        # int sdl_decode(const uint8_t* data, size_t len, int target_h,
+        #                int target_w, uint8_t* out, int* out_h, int* out_w,
+        #                int* out_c)
+        lib.sdl_decode.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.sdl_decode.restype = ctypes.c_int
+        lib.sdl_probe.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
+                                  ctypes.POINTER(ctypes.c_int),
+                                  ctypes.POINTER(ctypes.c_int),
+                                  ctypes.POINTER(ctypes.c_int)]
+        lib.sdl_probe.restype = ctypes.c_int
+        lib.sdl_decode_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_size_t),
+            ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int),
+            ctypes.c_int,
+        ]
+        lib.sdl_decode_batch.restype = ctypes.c_int
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def decode(data: bytes, target_size: Optional[Tuple[int, int]] = None
+           ) -> Optional[np.ndarray]:
+    """Decode (and optionally bilinear-resize) JPEG/PNG bytes → HWC uint8."""
+    lib = _load()
+    if lib is None:
+        return None
+    h = ctypes.c_int(0)
+    w = ctypes.c_int(0)
+    c = ctypes.c_int(0)
+    if lib.sdl_probe(data, len(data), ctypes.byref(h), ctypes.byref(w),
+                     ctypes.byref(c)) != 0:
+        return None
+    th, tw = (target_size if target_size is not None else (h.value, w.value))
+    out = np.empty((th, tw, max(c.value, 1)), dtype=np.uint8)
+    rc = lib.sdl_decode(
+        data, len(data), th, tw,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.byref(h), ctypes.byref(w), ctypes.byref(c))
+    if rc != 0:
+        return None
+    return out[:, :, :c.value] if out.shape[2] != c.value else out
+
+
+def decode_batch(blobs, target_size: Tuple[int, int], channels: int = 3,
+                 num_threads: int = 0) -> Optional[np.ndarray]:
+    """Decode many blobs into one NHWC uint8 array (threaded in C++).
+
+    Returns None if the native lib is missing or any blob fails to decode
+    (callers then fall back to the per-image path to isolate the failure).
+    """
+    lib = _load()
+    if lib is None or not blobs:
+        return None
+    n = len(blobs)
+    th, tw = target_size
+    ptrs = (ctypes.c_char_p * n)(*blobs)
+    lens = (ctypes.c_size_t * n)(*[len(b) for b in blobs])
+    out = np.empty((n, th, tw, channels), dtype=np.uint8)
+    status = (ctypes.c_int * n)()
+    rc = lib.sdl_decode_batch(
+        ptrs, lens, n, th, tw,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        status, num_threads)
+    # rc is the count of failures; status[i] != 0 marks blob i failed.
+    if rc != 0:
+        return None
+    return out

@@ -1,0 +1,47 @@
+"""Spark-ML-style ``Params`` system — the config/flag layer of the framework.
+
+Parity target: the reference's param layer (``python/sparkdl/param/`` in the
+upstream ``spark-deep-learning`` tree, per SURVEY.md §2.1 / §5.6 — the
+reference mount was empty this round, so no file:line cites are possible).
+The reference builds on ``pyspark.ml.param.Params``; pyspark is not in this
+environment, so the full contract is re-implemented here from scratch:
+
+- ``Param``: a typed, documented parameter *descriptor* attached to a class.
+- ``Params``: mixin giving per-instance param maps (`set`/`getOrDefault`),
+  defaults, ``extractParamMap``, ``copy`` with extra-map override, and
+  ``explainParams`` — the semantics Spark ML Pipelines rely on.
+- ``TypeConverters``: set-time validation/coercion.
+- ``keyword_only``: the ctor pattern used by every Transformer/Estimator.
+
+Everything downstream (transformers, estimators, the SQL-UDF registrar)
+configures itself through this module; there are no global flags.
+"""
+
+from sparkdl_tpu.param.base import Param, Params, keyword_only
+from sparkdl_tpu.param.converters import TypeConverters, SparkDLTypeConverters
+from sparkdl_tpu.param.shared_params import (
+    HasInputCol,
+    HasOutputCol,
+    HasLabelCol,
+    HasOutputMode,
+    HasBatchSize,
+    HasModelFunction,
+    HasInputDType,
+    CanLoadImage,
+)
+
+__all__ = [
+    "Param",
+    "Params",
+    "keyword_only",
+    "TypeConverters",
+    "SparkDLTypeConverters",
+    "HasInputCol",
+    "HasOutputCol",
+    "HasLabelCol",
+    "HasOutputMode",
+    "HasBatchSize",
+    "HasModelFunction",
+    "HasInputDType",
+    "CanLoadImage",
+]

@@ -1,0 +1,139 @@
+"""Type converters for set-time param validation.
+
+Parity: ``pyspark.ml.param.TypeConverters`` plus the reference's
+``SparkDLTypeConverters`` (upstream ``python/sparkdl/param/converters.py``,
+SURVEY.md §2.1 — cites are package-level, the reference mount was empty).
+The reference validated TF-tensor↔column-name mappings; the TPU rebuild
+validates model-io↔column-name mappings and model/mesh handles instead.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+
+class TypeConverters:
+    """Coercing validators mirroring ``pyspark.ml.param.TypeConverters``."""
+
+    @staticmethod
+    def identity(value: Any) -> Any:
+        return value
+
+    @staticmethod
+    def toString(value: Any) -> str:
+        if isinstance(value, str):
+            return value
+        raise TypeError(f"Could not convert {value!r} to string")
+
+    @staticmethod
+    def toInt(value: Any) -> int:
+        if isinstance(value, bool):
+            raise TypeError(f"Could not convert bool {value!r} to int")
+        if isinstance(value, (int, np.integer)):
+            return int(value)
+        if isinstance(value, float) and value.is_integer():
+            return int(value)
+        raise TypeError(f"Could not convert {value!r} to int")
+
+    @staticmethod
+    def toFloat(value: Any) -> float:
+        if isinstance(value, bool):
+            raise TypeError(f"Could not convert bool {value!r} to float")
+        if isinstance(value, (int, float, np.integer, np.floating)):
+            return float(value)
+        raise TypeError(f"Could not convert {value!r} to float")
+
+    @staticmethod
+    def toBoolean(value: Any) -> bool:
+        if isinstance(value, bool):
+            return value
+        raise TypeError(f"Could not convert {value!r} to bool")
+
+    @staticmethod
+    def toList(value: Any) -> List[Any]:
+        if isinstance(value, (list, tuple)):
+            return list(value)
+        if isinstance(value, np.ndarray):
+            return value.tolist()
+        raise TypeError(f"Could not convert {value!r} to list")
+
+    @staticmethod
+    def toListString(value: Any) -> List[str]:
+        return [TypeConverters.toString(v) for v in TypeConverters.toList(value)]
+
+    @staticmethod
+    def toListInt(value: Any) -> List[int]:
+        return [TypeConverters.toInt(v) for v in TypeConverters.toList(value)]
+
+    @staticmethod
+    def toListFloat(value: Any) -> List[float]:
+        return [TypeConverters.toFloat(v) for v in TypeConverters.toList(value)]
+
+
+class SparkDLTypeConverters:
+    """Framework-specific converters (reference parity, TPU-native payloads).
+
+    Where the reference validated ``{tf.Tensor-name: column-name}`` dicts for
+    ``TFTransformer`` (upstream ``SparkDLTypeConverters.asColumnToTensorNameMap``
+    etc.), the rebuild validates ``{model-input-name: column-name}`` maps for
+    :class:`~sparkdl_tpu.transformers.tensor.TensorTransformer`.
+    """
+
+    @staticmethod
+    def toColumnName(value: Any) -> str:
+        name = TypeConverters.toString(value)
+        if not name:
+            raise TypeError("column name must be non-empty")
+        return name
+
+    @staticmethod
+    def asColumnToInputMap(value: Any) -> Dict[str, str]:
+        """``{column-name: model-input-name}`` with string keys/values."""
+        if not isinstance(value, dict):
+            raise TypeError(f"Could not convert {value!r} to col->input map")
+        out = {}
+        for k, v in sorted(value.items()):
+            out[SparkDLTypeConverters.toColumnName(k)] = TypeConverters.toString(v)
+        return out
+
+    @staticmethod
+    def asOutputToColumnMap(value: Any) -> Dict[str, str]:
+        """``{model-output-name: column-name}`` with string keys/values."""
+        if not isinstance(value, dict):
+            raise TypeError(f"Could not convert {value!r} to output->col map")
+        out = {}
+        for k, v in sorted(value.items()):
+            out[TypeConverters.toString(k)] = SparkDLTypeConverters.toColumnName(v)
+        return out
+
+    @staticmethod
+    def toModelFunction(value: Any):
+        """Validate a ModelFunction-like object (duck-typed to avoid cycles)."""
+        if hasattr(value, "apply") and hasattr(value, "variables"):
+            return value
+        raise TypeError(
+            f"Expected a ModelFunction (has .apply/.variables), got {type(value).__name__}")
+
+    @staticmethod
+    def supportedNameConverter(supportedList: List[str]):
+        """Converter factory: value must be one of ``supportedList``.
+
+        Mirrors the reference's converter used for ``modelName`` on
+        ``DeepImagePredictor``/``DeepImageFeaturizer``.
+        """
+
+        def converter(value: Any) -> str:
+            if value in supportedList:
+                return value
+            raise TypeError(f"{value!r} is not in the supported list {supportedList}")
+
+        return converter
+
+    @staticmethod
+    def toOutputMode(value: Any) -> str:
+        mode = TypeConverters.toString(value)
+        if mode not in ("vector", "image", "tensor"):
+            raise TypeError(f"outputMode must be 'vector', 'image' or 'tensor', got {mode!r}")
+        return mode

@@ -1,0 +1,159 @@
+"""Shared param mixins used across transformers/estimators.
+
+Parity: upstream ``python/sparkdl/param/shared_params.py`` +
+``image_params.py`` (SURVEY.md §2.1). The reference's mixins were
+``HasInputCol/HasOutputCol/HasLabelCol``, ``HasKerasModel``,
+``HasKerasOptimizer``, ``HasKerasLoss``, ``HasOutputMode``, and
+``CanLoadImage``; the TPU rebuild keeps the names and semantics, swapping
+Keras/TF payloads for JAX-native ones (``ModelFunction``, optax optimizers).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from sparkdl_tpu.param.base import Param, Params
+from sparkdl_tpu.param.converters import SparkDLTypeConverters, TypeConverters
+
+
+class HasInputCol(Params):
+    inputCol = Param(
+        "HasInputCol", "inputCol", "name of the input column",
+        typeConverter=SparkDLTypeConverters.toColumnName)
+
+    def setInputCol(self, value: str) -> "HasInputCol":
+        return self._set(inputCol=value)
+
+    def getInputCol(self) -> str:
+        return self.getOrDefault(self.inputCol)
+
+
+class HasOutputCol(Params):
+    outputCol = Param(
+        "HasOutputCol", "outputCol", "name of the output column",
+        typeConverter=SparkDLTypeConverters.toColumnName)
+
+    def setOutputCol(self, value: str) -> "HasOutputCol":
+        return self._set(outputCol=value)
+
+    def getOutputCol(self) -> str:
+        return self.getOrDefault(self.outputCol)
+
+
+class HasLabelCol(Params):
+    labelCol = Param(
+        "HasLabelCol", "labelCol",
+        "name of the label column (one-hot or class-index encoded)",
+        typeConverter=SparkDLTypeConverters.toColumnName)
+
+    def setLabelCol(self, value: str) -> "HasLabelCol":
+        return self._set(labelCol=value)
+
+    def getLabelCol(self) -> str:
+        return self.getOrDefault(self.labelCol)
+
+
+class HasOutputMode(Params):
+    outputMode = Param(
+        "HasOutputMode", "outputMode",
+        "how model output is written: 'vector' (flattened 1-D) or 'image' "
+        "(re-encoded image struct)",
+        typeConverter=SparkDLTypeConverters.toOutputMode)
+
+    def setOutputMode(self, value: str) -> "HasOutputMode":
+        return self._set(outputMode=value)
+
+    def getOutputMode(self) -> str:
+        return self.getOrDefault(self.outputMode)
+
+
+class HasBatchSize(Params):
+    batchSize = Param(
+        "HasBatchSize", "batchSize",
+        "device batch size; rows are padded to this for static XLA shapes",
+        typeConverter=TypeConverters.toInt)
+
+    def setBatchSize(self, value: int) -> "HasBatchSize":
+        return self._set(batchSize=value)
+
+    def getBatchSize(self) -> int:
+        return self.getOrDefault(self.batchSize)
+
+
+class HasModelFunction(Params):
+    """The rebuild's analog of the reference's ``tfInputGraph``/Keras-model
+    params: a :class:`sparkdl_tpu.core.model_function.ModelFunction`."""
+
+    modelFunction = Param(
+        "HasModelFunction", "modelFunction",
+        "ModelFunction to apply (pure apply fn + params pytree + input spec)",
+        typeConverter=SparkDLTypeConverters.toModelFunction)
+
+    def setModelFunction(self, value: Any) -> "HasModelFunction":
+        return self._set(modelFunction=value)
+
+    def getModelFunction(self):
+        return self.getOrDefault(self.modelFunction)
+
+
+class HasInputDType(Params):
+    inputDType = Param(
+        "HasInputDType", "inputDType",
+        "numpy dtype name the input column is cast to before device transfer",
+        typeConverter=TypeConverters.toString)
+
+    def setInputDType(self, value: str) -> "HasInputDType":
+        return self._set(inputDType=value)
+
+    def getInputDType(self) -> str:
+        return self.getOrDefault(self.inputDType)
+
+
+class CanLoadImage(Params):
+    """Mixin for components that load image files from a URI column.
+
+    Parity: upstream ``CanLoadImage.loadImagesInternal`` — a UDF mapping
+    URI → decoded PIL image → user preprocessor → image struct. Here the
+    decode path is the imageIO host pipeline (native C++ decoder when built,
+    PIL fallback) and the result is an Arrow image-struct column.
+    """
+
+    imageLoader = Param(
+        "CanLoadImage", "imageLoader",
+        "callable URI -> HWC float/uint8 numpy array (decode + preprocess); "
+        "None uses the default decode+resize for the model's input size",
+        typeConverter=TypeConverters.identity)
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._setDefault(imageLoader=None)
+
+    def setImageLoader(self, value: Optional[Callable]) -> "CanLoadImage":
+        return self._set(imageLoader=value)
+
+    def getImageLoader(self) -> Optional[Callable]:
+        return self.getOrDefault(self.imageLoader)
+
+    def loadImagesInternal(self, dataframe, inputCol: str, outputCol: str,
+                           target_size=None):
+        """Add ``outputCol`` of image structs decoded from URI ``inputCol``.
+
+        Runs host-side, partition-parallel (the reference ran it as a Spark
+        Python-worker UDF; here it is an engine map over Arrow partitions).
+        """
+        from sparkdl_tpu.image import imageIO  # lazy: avoid import cycle
+
+        loader = self.getOrDefault(self.imageLoader)
+
+        def load_one(uri: str):
+            if loader is not None:
+                arr = loader(uri)
+            else:
+                arr = imageIO.decodeImageFile(uri, target_size=target_size)
+            if arr is None:
+                return None
+            return imageIO.imageArrayToStruct(arr)
+
+        return dataframe.withColumn(
+            outputCol, load_one, inputCols=[inputCol],
+            outputType=imageIO.imageSchema)

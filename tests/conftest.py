@@ -1,0 +1,47 @@
+"""Test harness configuration.
+
+Tests run on a virtual 8-device CPU mesh (the environment has one real TPU
+chip; mesh/sharding logic is validated on faked host devices exactly as
+SURVEY.md §4 prescribes). These env vars MUST be set before jax is first
+imported, hence they live at module import time in conftest.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+# Repo root on sys.path so `import sparkdl_tpu` works without install.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture
+def tiny_image_dir(tmp_path):
+    """A directory of small deterministic JPEG+PNG fixtures."""
+    from PIL import Image
+
+    rng = np.random.default_rng(0)
+    paths = []
+    for i in range(4):
+        arr = rng.integers(0, 255, size=(32 + 8 * i, 40, 3), dtype=np.uint8)
+        p = tmp_path / f"img_{i}.jpg"
+        Image.fromarray(arr).save(p, quality=95)
+        paths.append(p)
+    arr = rng.integers(0, 255, size=(24, 24, 3), dtype=np.uint8)
+    p = tmp_path / "img_png.png"
+    Image.fromarray(arr).save(p)
+    paths.append(p)
+    (tmp_path / "not_an_image.txt").write_text("hello")
+    return tmp_path

@@ -1,0 +1,169 @@
+"""Engine DataFrame tests — partitioned execution, retry, columnar UDFs."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from sparkdl_tpu.engine import DataFrame, EngineConfig, TaskFailure
+from sparkdl_tpu.engine.dataframe import column_to_numpy, fixed_size_list_array
+
+
+def make_df(n=10, parts=3):
+    return DataFrame.fromPandas(
+        pd.DataFrame({"x": np.arange(n, dtype=np.int64),
+                      "y": np.arange(n, dtype=np.float64) * 2.0}),
+        numPartitions=parts)
+
+
+def test_partitioning_and_count():
+    df = make_df(10, 3)
+    assert df.numPartitions == 3
+    assert df.count() == 10
+    assert df.columns == ["x", "y"]
+
+
+def test_collect_order_preserved():
+    df = make_df(10, 4)
+    rows = df.collect()
+    assert [r["x"] for r in rows] == list(range(10))
+
+
+def test_select_drop_rename():
+    df = make_df()
+    assert df.select("y").columns == ["y"]
+    assert df.drop("x").columns == ["y"]
+    assert df.withColumnRenamed("x", "z").columns == ["z", "y"]
+    with pytest.raises(KeyError):
+        df.select("nope")
+
+
+def test_with_column_rowwise():
+    df = make_df(6, 2)
+    out = df.withColumn("sum", lambda x, y: float(x) + y,
+                        inputCols=["x", "y"], outputType=pa.float64())
+    rows = out.collect()
+    assert all(r["sum"] == r["x"] + r["y"] for r in rows)
+
+
+def test_with_column_batch_vectorized():
+    df = make_df(8, 3)
+
+    def double(batch: pa.RecordBatch) -> pa.Array:
+        x = column_to_numpy(batch.column(0))
+        return pa.array(x * 2)
+
+    rows = df.withColumnBatch("x2", double, outputType=pa.int64()).collect()
+    assert all(r["x2"] == 2 * r["x"] for r in rows)
+
+
+def test_filter_and_dropna():
+    df = make_df(10, 2)
+    assert df.filter(lambda x: x % 2 == 0, inputCols=["x"]).count() == 5
+    df2 = DataFrame.fromRows([{"a": 1}, {"a": None}, {"a": 3}])
+    assert df2.dropna().count() == 2
+
+
+def test_limit_union_repartition():
+    df = make_df(10, 3)
+    assert df.limit(4).count() == 4
+    assert df.union(make_df(5, 1)).count() == 15
+    assert df.repartition(5).numPartitions == 5
+    assert df.repartition(5).count() == 10
+
+
+def test_lazy_ops_compose():
+    df = make_df(10, 2)
+    out = (df.withColumn("a", lambda x: x + 1, ["x"], pa.int64())
+             .withColumn("b", lambda a: a * 10, ["a"], pa.int64())
+             .select("b"))
+    assert [r["b"] for r in out.collect()] == [(i + 1) * 10 for i in range(10)]
+
+
+def test_retry_recovers_transient_failure():
+    df = make_df(6, 3)
+    failures = {"left": 1}
+
+    def injector(pidx, attempt):
+        if pidx == 1 and failures["left"] > 0:
+            failures["left"] -= 1
+            raise RuntimeError("transient")
+
+    EngineConfig.fault_injector = injector
+    try:
+        assert df.withColumn("z", lambda x: x, ["x"], pa.int64()).count() == 6
+    finally:
+        EngineConfig.fault_injector = None
+
+
+def test_retry_exhaustion_raises():
+    df = make_df(6, 3)
+
+    def injector(pidx, attempt):
+        if pidx == 0:
+            raise RuntimeError("permanent")
+
+    EngineConfig.fault_injector = injector
+    try:
+        with pytest.raises(TaskFailure):
+            df.withColumn("z", lambda x: x, ["x"], pa.int64()).count()
+    finally:
+        EngineConfig.fault_injector = None
+
+
+def test_fixed_size_list_roundtrip(rng):
+    mat = rng.standard_normal((5, 7)).astype(np.float32)
+    arr = fixed_size_list_array(mat)
+    assert arr.type == pa.list_(pa.float32(), 7)
+    back = column_to_numpy(arr)
+    np.testing.assert_array_equal(mat, back)
+
+
+def test_from_columns_ndarray(rng):
+    feats = rng.standard_normal((4, 3)).astype(np.float32)
+    df = DataFrame.fromColumns({"id": list(range(4)), "f": feats})
+    back = column_to_numpy(df.toArrow().column("f"))
+    np.testing.assert_array_equal(back, feats)
+
+
+def test_cache_materializes_once():
+    calls = {"n": 0}
+    df = make_df(4, 2)
+
+    def op(batch):
+        calls["n"] += 1
+        return pa.array([1] * batch.num_rows)
+
+    out = df.withColumnBatch("one", op, pa.int64()).cache()
+    out.collect()
+    out.collect()
+    assert calls["n"] == 2  # once per partition, not per collect
+
+
+def test_with_column_no_output_type_then_select():
+    # Regression: declared null-typed schema must not be forced onto batches.
+    df = make_df(6, 2)
+    out = df.withColumn("name", lambda x: f"row{x}", ["x"]).select("name")
+    assert [r["name"] for r in out.collect()] == [f"row{i}" for i in range(6)]
+
+
+def test_heterogeneous_inferred_types_unify():
+    # Partition 0 infers null type, partition 1 infers int64 -> unify.
+    df = DataFrame.fromRows([{"x": 1}, {"x": 2}], numPartitions=2)
+    out = df.withColumn("y", lambda x: None if x == 1 else x, ["x"])
+    rows = out.collect()
+    assert rows[0]["y"] is None and rows[1]["y"] == 2
+
+
+def test_cache_reused_by_derived_frames():
+    calls = {"n": 0}
+    df = make_df(4, 2)
+
+    def op(batch):
+        calls["n"] += 1
+        return pa.array([1.0] * batch.num_rows)
+
+    cached = df.withColumnBatch("c", op, pa.float64()).cache()
+    n_after_cache = calls["n"]
+    cached.select("c").collect()
+    assert calls["n"] == n_after_cache  # derived frame reused materialization

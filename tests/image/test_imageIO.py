@@ -1,0 +1,98 @@
+"""imageIO tests — schema contract + codecs + readers (SURVEY.md §2.1 L3)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from sparkdl_tpu.image import imageIO
+
+
+def test_schema_fields_match_reference_contract():
+    assert imageIO.imageFields == [
+        "origin", "height", "width", "nChannels", "mode", "data"]
+
+
+def test_array_struct_roundtrip_uint8(rng):
+    arr = rng.integers(0, 255, size=(17, 23, 3), dtype=np.uint8)
+    struct = imageIO.imageArrayToStruct(arr, origin="mem")
+    assert struct["mode"] == 16  # CV_8UC3
+    assert struct["height"] == 17 and struct["width"] == 23
+    back = imageIO.imageStructToArray(struct)
+    np.testing.assert_array_equal(arr, back)
+
+
+def test_array_struct_roundtrip_float32(rng):
+    arr = rng.standard_normal((8, 9, 1)).astype(np.float32)
+    struct = imageIO.imageArrayToStruct(arr)
+    assert struct["mode"] == 5  # CV_32FC1
+    back = imageIO.imageStructToArray(struct)
+    np.testing.assert_array_equal(arr, back)
+
+
+def test_2d_array_promoted_to_single_channel(rng):
+    arr = rng.integers(0, 255, size=(5, 6), dtype=np.uint8)
+    struct = imageIO.imageArrayToStruct(arr)
+    assert struct["nChannels"] == 1 and struct["mode"] == 0
+
+
+def test_unsupported_dtype_rejected():
+    with pytest.raises(ValueError):
+        imageIO.imageArrayToStruct(np.zeros((4, 4, 3), dtype=np.int64))
+    with pytest.raises(ValueError):
+        imageIO.imageTypeByCode(999)
+
+
+def test_struct_array_arrow_roundtrip(rng):
+    arr = rng.integers(0, 255, size=(4, 4, 3), dtype=np.uint8)
+    struct = imageIO.imageArrayToStruct(arr, origin="x")
+    pa_arr = pa.array([struct], type=imageIO.imageSchema)
+    back = imageIO.imageStructToArray(pa_arr[0])
+    np.testing.assert_array_equal(arr, back)
+
+
+def test_resize_uint8(rng):
+    arr = rng.integers(0, 255, size=(10, 20, 3), dtype=np.uint8)
+    out = imageIO.resizeImageArray(arr, (5, 5))
+    assert out.shape == (5, 5, 3) and out.dtype == np.uint8
+
+
+def test_resize_float32(rng):
+    arr = rng.standard_normal((10, 20, 3)).astype(np.float32)
+    out = imageIO.resizeImageArray(arr, (4, 8))
+    assert out.shape == (4, 8, 3) and out.dtype == np.float32
+
+
+def test_batch_decode_with_resize(rng):
+    structs = [
+        imageIO.imageArrayToStruct(
+            rng.integers(0, 255, size=(h, 12, 3), dtype=np.uint8))
+        for h in (6, 9, 12)
+    ]
+    batch = imageIO.imageStructsToBatchArray(structs, target_size=(8, 8))
+    assert batch.shape == (3, 8, 8, 3) and batch.dtype == np.float32
+
+
+def test_read_images(tiny_image_dir):
+    df = imageIO.readImages(str(tiny_image_dir))
+    rows = df.collect()
+    assert len(rows) == 5  # txt file is not listed
+    ok = [r for r in rows if r["image"] is not None]
+    assert len(ok) == 5
+    first = ok[0]["image"]
+    assert first["nChannels"] == 3
+    decoded = imageIO.imageStructToArray(first)
+    assert decoded.shape == (first["height"], first["width"], 3)
+
+
+def test_read_images_undecodable_yields_null(tmp_path):
+    bad = tmp_path / "bad.jpg"
+    bad.write_bytes(b"this is not a jpeg")
+    df = imageIO.readImages(str(tmp_path))
+    rows = df.collect()
+    assert len(rows) == 1 and rows[0]["image"] is None
+
+
+def test_decode_image_file_resize(tiny_image_dir):
+    files = imageIO.listImageFiles(str(tiny_image_dir))
+    arr = imageIO.decodeImageFile(files[0], target_size=(16, 16))
+    assert arr.shape == (16, 16, 3) and arr.dtype == np.uint8

@@ -1,0 +1,98 @@
+"""Params system tests — Spark ML semantics (SURVEY.md §5.6 parity)."""
+
+import pytest
+
+from sparkdl_tpu.param import (
+    HasInputCol, HasOutputCol, Param, Params, TypeConverters, keyword_only,
+    SparkDLTypeConverters,
+)
+
+
+class _Widget(HasInputCol, HasOutputCol):
+    size = Param("_Widget", "size", "widget size", TypeConverters.toInt)
+
+    @keyword_only
+    def __init__(self, inputCol=None, outputCol=None, size=None):
+        super().__init__()
+        self._setDefault(size=3, outputCol="out")
+        self._set(**self._input_kwargs)
+
+    @keyword_only
+    def setParams(self, inputCol=None, outputCol=None, size=None):
+        return self._set(**self._input_kwargs)
+
+
+def test_defaults_and_set():
+    w = _Widget(inputCol="a")
+    assert w.getInputCol() == "a"
+    assert w.getOutputCol() == "out"  # default
+    assert w.getOrDefault("size") == 3
+    w.setParams(size=7)
+    assert w.getOrDefault(w.size) == 7
+    assert w.isSet(w.size) and w.hasDefault(w.size)
+
+
+def test_type_conversion_and_errors():
+    w = _Widget(inputCol="a")
+    w.set(w.size, 5.0)  # float that is an int
+    assert w.getOrDefault(w.size) == 5
+    with pytest.raises(TypeError):
+        w.set(w.size, "nope")
+    with pytest.raises(TypeError):
+        _Widget(inputCol=123)
+
+
+def test_instances_do_not_share_state():
+    w1 = _Widget(inputCol="a")
+    w2 = _Widget(inputCol="b")
+    w1.setParams(size=9)
+    assert w2.getOrDefault("size") == 3
+    assert w1.uid != w2.uid
+    # Param identity is bound to instance uid
+    assert w1.size != w2.size
+
+
+def test_copy_with_extra_keeps_uid():
+    w = _Widget(inputCol="a", size=5)
+    extra = {w.size: 11}
+    w2 = w.copy(extra)
+    assert w2.uid == w.uid
+    assert w2.getOrDefault("size") == 11
+    assert w.getOrDefault("size") == 5  # original untouched
+    w2.setParams(inputCol="z")
+    assert w.getInputCol() == "a"
+
+
+def test_extract_param_map_layering():
+    w = _Widget(inputCol="a")
+    pm = w.extractParamMap()
+    assert pm[w.size] == 3
+    pm2 = w.extractParamMap({w.size: 99})
+    assert pm2[w.size] == 99
+
+
+def test_keyword_only_rejects_positional():
+    with pytest.raises(TypeError):
+        _Widget("a")
+
+
+def test_explain_params():
+    w = _Widget(inputCol="a")
+    text = w.explainParams()
+    assert "inputCol" in text and "size" in text and "default: 3" in text
+
+
+def test_supported_name_converter():
+    conv = SparkDLTypeConverters.supportedNameConverter(["X", "Y"])
+    assert conv("X") == "X"
+    with pytest.raises(TypeError):
+        conv("Z")
+
+
+def test_col_map_converters():
+    m = SparkDLTypeConverters.asColumnToInputMap({"col": "input"})
+    assert m == {"col": "input"}
+    with pytest.raises(TypeError):
+        SparkDLTypeConverters.asColumnToInputMap([("a", "b")])
+    with pytest.raises(TypeError):
+        SparkDLTypeConverters.asOutputToColumnMap({"out": ""})
