@@ -1,0 +1,21 @@
+"""Core runtime: mesh/device setup, ModelFunction, batching, checkpointing.
+
+The rebuild's L2 (the reference's graph toolkit, SURVEY.md §1) — except the
+"graph" is a pure function and the "session" is jit+PJRT.
+"""
+
+from sparkdl_tpu.core.mesh import (
+    DATA_AXIS, MODEL_AXIS, CONTEXT_AXIS, EXPERT_AXIS,
+    MeshConfig, make_mesh, data_parallel_mesh, batch_sharding, replicated,
+    shard_batch,
+)
+from sparkdl_tpu.core.model_function import ModelFunction, InputModel, TensorSpec
+from sparkdl_tpu.core import batching
+
+__all__ = [
+    "DATA_AXIS", "MODEL_AXIS", "CONTEXT_AXIS", "EXPERT_AXIS",
+    "MeshConfig", "make_mesh", "data_parallel_mesh", "batch_sharding",
+    "replicated", "shard_batch",
+    "ModelFunction", "InputModel", "TensorSpec",
+    "batching",
+]

@@ -1,0 +1,63 @@
+"""Static-shape batching utilities.
+
+XLA compiles one program per input shape; variable row counts per partition
+would retrace endlessly. Everything device-bound therefore runs at a fixed
+``batch_size``: partitions are chunked, the tail chunk is zero-padded and
+the pad rows dropped after compute. (The reference had the same constraint
+implicitly — TF graphs with fixed input sizes; SURVEY.md §7 "Dynamic
+shapes".)
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, Optional, Tuple
+
+import numpy as np
+
+
+def pad_batch(arr: np.ndarray, batch_size: int) -> Tuple[np.ndarray, int]:
+    """Zero-pad dim 0 up to ``batch_size``; returns (padded, n_valid)."""
+    n = arr.shape[0]
+    if n == batch_size:
+        return arr, n
+    if n > batch_size:
+        raise ValueError(f"batch of {n} rows exceeds batch_size {batch_size}")
+    pad_widths = [(0, batch_size - n)] + [(0, 0)] * (arr.ndim - 1)
+    return np.pad(arr, pad_widths), n
+
+
+def iter_batches(arr: np.ndarray, batch_size: int
+                 ) -> Iterator[Tuple[np.ndarray, int]]:
+    """Yield (padded_chunk, n_valid) fixed-shape chunks over dim 0."""
+    n = arr.shape[0]
+    if n == 0:
+        return
+    for start in range(0, n, batch_size):
+        yield pad_batch(arr[start:start + batch_size], batch_size)
+
+
+def run_batched(fn: Callable[[np.ndarray], object], arr: np.ndarray,
+                batch_size: int) -> np.ndarray:
+    """Apply a fixed-batch device fn over all rows, concatenating outputs.
+
+    ``fn`` must accept a (batch_size, ...) array and return a device array
+    whose dim 0 aligns with the input rows. JAX's async dispatch overlaps
+    the host staging of chunk k+1 with device compute of chunk k: we
+    dispatch all chunks before blocking on any result.
+    """
+    outs = []
+    valids = []
+    for chunk, n_valid in iter_batches(arr, batch_size):
+        outs.append(fn(chunk))  # dispatched async; do not block here
+        valids.append(n_valid)
+    if not outs:
+        # Preserve the output *element* shape for empty inputs: run one
+        # dummy padded batch through shape inference only.
+        import jax
+
+        dummy = jax.eval_shape(fn, jax.ShapeDtypeStruct(
+            (batch_size,) + arr.shape[1:], arr.dtype))
+        return np.zeros((0,) + tuple(dummy.shape[1:]),
+                        dtype=np.dtype(dummy.dtype))
+    host = [np.asarray(o)[:v] for o, v in zip(outs, valids)]
+    return np.concatenate(host, axis=0)

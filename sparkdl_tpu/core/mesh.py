@@ -1,0 +1,102 @@
+"""Device mesh construction + sharding helpers.
+
+The rebuild's replacement for the reference's distribution substrate
+(Spark partition scheduling + Horovod/NCCL rings; SURVEY.md §2.4, §5.8):
+a named-axis ``jax.sharding.Mesh`` over which batch data is sharded on
+``data``, parameters optionally sharded on ``model`` (tensor parallelism),
+long sequences on ``context`` (ring attention / sequence parallelism), and
+experts on ``expert``. Collectives are never hand-written — XLA emits them
+over ICI/DCN from these declarative shardings.
+
+Axis names are fixed framework-wide so PartitionSpec rules compose:
+``data`` | ``model`` | ``context`` | ``expert``.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+CONTEXT_AXIS = "context"
+EXPERT_AXIS = "expert"
+
+ALL_AXES = (DATA_AXIS, MODEL_AXIS, CONTEXT_AXIS, EXPERT_AXIS)
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Declarative mesh shape; -1 on ``data`` absorbs remaining devices.
+
+    On a multi-host pod this is created identically on every process
+    (jax.devices() is global); the ``data`` axis spans hosts so per-host
+    input pipelines feed their local shard (DCN traffic only where the
+    axis crosses hosts — the HorovodRunner-equivalent layout).
+    """
+
+    data: int = -1
+    model: int = 1
+    context: int = 1
+    expert: int = 1
+
+    def resolve(self, n_devices: Optional[int] = None) -> Dict[str, int]:
+        n = n_devices if n_devices is not None else len(jax.devices())
+        fixed = self.model * self.context * self.expert
+        if n % fixed != 0:
+            raise ValueError(
+                f"device count {n} not divisible by model*context*expert={fixed}")
+        data = self.data if self.data != -1 else n // fixed
+        if data * fixed != n:
+            raise ValueError(
+                f"mesh shape data={data} model={self.model} "
+                f"context={self.context} expert={self.expert} does not cover "
+                f"{n} devices")
+        return {DATA_AXIS: data, MODEL_AXIS: self.model,
+                CONTEXT_AXIS: self.context, EXPERT_AXIS: self.expert}
+
+
+def make_mesh(config: Optional[MeshConfig] = None,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a Mesh with the framework's named axes.
+
+    Axes of size 1 are kept (harmless, and they let PartitionSpec rules be
+    written once for every topology). Device order follows ``jax.devices()``
+    which already snakes physical ICI topology on TPU backends.
+    """
+    config = config or MeshConfig()
+    devices = list(devices) if devices is not None else jax.devices()
+    shape = config.resolve(len(devices))
+    arr = np.asarray(devices).reshape(tuple(shape[a] for a in ALL_AXES))
+    return Mesh(arr, ALL_AXES)
+
+
+def data_parallel_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    return make_mesh(MeshConfig(), devices)
+
+
+def batch_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    """Shard dim 0 (batch) across ``data``, replicate the rest."""
+    return NamedSharding(mesh, P(DATA_AXIS, *([None] * (ndim - 1))))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh: Mesh, array) -> jax.Array:
+    """device_put a host NHWC/ND batch sharded on ``data`` along dim 0."""
+    return jax.device_put(array, batch_sharding(mesh, np.ndim(array)))
+
+
+def data_axis_size(mesh: Mesh) -> int:
+    return mesh.shape[DATA_AXIS]
+
+
+def pad_to_multiple(n: int, multiple: int) -> int:
+    return int(math.ceil(n / multiple) * multiple)

@@ -1,0 +1,277 @@
+"""ModelFunction — the model abstraction at the center of the framework.
+
+Parity map (SURVEY.md §7): the reference's ``TFInputGraph`` /
+``GraphFunction`` carried a serialized TF graph plus input/output endpoint
+names, ingested from five formats and composed by graph splicing. The
+TPU-native equivalent is *a pure function + a params pytree + an input
+spec*:
+
+- composition is function composition (``with_preprocess`` /
+  ``with_postprocess``), traced and fused into ONE XLA program by ``jit``;
+- the ingestion matrix (``fromFlax``, ``fromFunction``, ``fromMsgpack``,
+  ``fromOrbax``, ``fromJaxExport``) mirrors ``TFInputGraph.fromGraph /
+  fromGraphDef / fromSavedModel[WithSignature] / fromCheckpoint[...]``;
+- ``fromJaxExport`` is the frozen-graph analog: a serialized StableHLO
+  artifact with weights baked in, runnable without the Python model class;
+- execution is shape-specialized and cached (one compile per batch size /
+  mesh), with batches padded to static shapes (core.batching).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparkdl_tpu.core import batching
+from sparkdl_tpu.core.mesh import batch_sharding, replicated
+
+
+@dataclass(frozen=True)
+class TensorSpec:
+    """Shape/dtype contract for one model input; dim 0 None = batch."""
+
+    shape: Tuple[Optional[int], ...]
+    dtype: str = "float32"
+
+    def with_batch(self, batch_size: int) -> Tuple[int, ...]:
+        return tuple(batch_size if d is None else d for d in self.shape)
+
+    @property
+    def element_shape(self) -> Tuple[int, ...]:
+        return tuple(d for d in self.shape[1:])
+
+
+class ModelFunction:
+    """A pure ``apply(variables, x) -> y`` + variables + input spec.
+
+    ``apply_fn`` must be jax-traceable and side-effect free. ``variables``
+    is any pytree (Flax ``{'params': ...}`` dicts, raw arrays, or None for
+    frozen exported artifacts whose weights are baked in).
+    """
+
+    def __init__(self, apply_fn: Callable[[Any, jax.Array], jax.Array],
+                 variables: Any, input_spec: TensorSpec,
+                 name: str = "model") -> None:
+        self.apply_fn = apply_fn
+        self.variables = variables
+        self.input_spec = input_spec
+        self.name = name
+        self._jit_cache: Dict[Tuple, Callable] = {}
+
+    # -- construction matrix (TFInputGraph parity) ---------------------------
+
+    @classmethod
+    def fromFunction(cls, fn: Callable, variables: Any, input_spec: TensorSpec,
+                     name: str = "fn") -> "ModelFunction":
+        """From an in-memory pure function — ``TFInputGraph.fromGraph`` analog."""
+        return cls(fn, variables, input_spec, name=name)
+
+    @classmethod
+    def fromFlax(cls, module, variables: Any, input_spec: TensorSpec,
+                 name: Optional[str] = None, **apply_kwargs) -> "ModelFunction":
+        """From a Flax module + variables (``fromGraphDef`` analog).
+
+        ``apply_kwargs`` are closed over (e.g. ``train=False``); mutable
+        collections are not updated — inference semantics.
+        """
+
+        def apply_fn(vs, x):
+            return module.apply(vs, x, **apply_kwargs)
+
+        return cls(apply_fn, variables, input_spec,
+                   name=name or type(module).__name__)
+
+    @classmethod
+    def fromMsgpack(cls, path: str, module, input_spec: TensorSpec,
+                    name: Optional[str] = None, **apply_kwargs) -> "ModelFunction":
+        """From Flax msgpack bytes on disk (``fromCheckpoint`` analog).
+
+        The module provides the pytree structure; weights are restored into
+        a freshly-initialized template so structure mismatches fail loudly.
+        """
+        import flax.serialization as fser
+
+        template = _init_template(module, input_spec)
+        with open(path, "rb") as f:
+            variables = fser.from_bytes(template, f.read())
+        return cls.fromFlax(module, variables, input_spec,
+                            name=name or type(module).__name__, **apply_kwargs)
+
+    @classmethod
+    def fromOrbax(cls, directory: str, module, input_spec: TensorSpec,
+                  name: Optional[str] = None, **apply_kwargs) -> "ModelFunction":
+        """From an Orbax checkpoint directory (``fromSavedModel`` analog)."""
+        import orbax.checkpoint as ocp
+
+        with ocp.StandardCheckpointer() as ckptr:
+            template = _init_template(module, input_spec)
+            variables = ckptr.restore(os.path.abspath(directory), template)
+        return cls.fromFlax(module, variables, input_spec,
+                            name=name or type(module).__name__, **apply_kwargs)
+
+    @classmethod
+    def fromJaxExport(cls, path_or_bytes, name: str = "exported"
+                      ) -> "ModelFunction":
+        """From a serialized ``jax.export`` artifact — the frozen-graph path.
+
+        Weights are baked into the StableHLO program (the reference's
+        ``strip_and_freeze_until`` produced exactly this kind of artifact
+        from TF graphs); no Python model class is needed to run it.
+        """
+        import jax.export as jex
+
+        if isinstance(path_or_bytes, (bytes, bytearray)):
+            blob = bytes(path_or_bytes)
+        else:
+            with open(path_or_bytes, "rb") as f:
+                blob = f.read()
+        exported = jex.deserialize(blob)
+        aval = exported.in_avals[0]
+        shape = tuple(None if not isinstance(d, int) else int(d)
+                      for d in aval.shape)
+        spec = TensorSpec(shape, np.dtype(aval.dtype).name)
+
+        def apply_fn(_vs, x):
+            return exported.call(x)
+
+        return cls(apply_fn, None, spec, name=name)
+
+    # -- serialization -------------------------------------------------------
+
+    def toMsgpack(self, path: str) -> None:
+        import flax.serialization as fser
+
+        with open(path, "wb") as f:
+            f.write(fser.to_bytes(self.variables))
+
+    def toOrbax(self, directory: str) -> None:
+        import orbax.checkpoint as ocp
+
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(os.path.abspath(directory), self.variables)
+            ckptr.wait_until_finished()
+
+    def toJaxExport(self, path: Optional[str] = None,
+                    batch_size: Optional[int] = None) -> bytes:
+        """Serialize as StableHLO with weights baked in.
+
+        With ``batch_size=None`` the batch dim is exported symbolically so
+        the artifact runs at any batch size; pass a fixed size if symbolic
+        export is unsupported for the program.
+        """
+        import jax.export as jex
+
+        def fn(x):
+            return self.apply_fn(self.variables, x)
+
+        if batch_size is None:
+            dims = ",".join(["b"] + [str(d) for d in self.input_spec.element_shape])
+            shape = jex.symbolic_shape(dims)
+        else:
+            shape = self.input_spec.with_batch(batch_size)
+        arg = jax.ShapeDtypeStruct(shape, jnp.dtype(self.input_spec.dtype))
+        exported = jex.export(jax.jit(fn))(arg)
+        blob = exported.serialize()
+        if path is not None:
+            with open(path, "wb") as f:
+                f.write(blob)
+        return blob
+
+    # -- composition (graph-splicing parity) ---------------------------------
+
+    def with_preprocess(self, pre: Callable[[jax.Array], jax.Array],
+                        input_spec: Optional[TensorSpec] = None
+                        ) -> "ModelFunction":
+        """Return a ModelFunction computing ``apply(vars, pre(x))``.
+
+        ``pre`` must be jax-traceable; it fuses into the same XLA program
+        (the reference spliced ``buildSpImageConverter`` graph pieces in
+        front — here it is function composition, SURVEY.md §3.2).
+        """
+        apply_fn = self.apply_fn
+
+        def fn(vs, x):
+            return apply_fn(vs, pre(x))
+
+        return ModelFunction(fn, self.variables, input_spec or self.input_spec,
+                             name=self.name)
+
+    def with_postprocess(self, post: Callable[[jax.Array], jax.Array]
+                         ) -> "ModelFunction":
+        apply_fn = self.apply_fn
+
+        def fn(vs, x):
+            return post(apply_fn(vs, x))
+
+        return ModelFunction(fn, self.variables, self.input_spec, name=self.name)
+
+    def flattened(self) -> "ModelFunction":
+        """Flatten outputs to (batch, -1) — the ``buildFlattener`` analog."""
+        return self.with_postprocess(lambda y: y.reshape(y.shape[0], -1))
+
+    # -- execution -----------------------------------------------------------
+
+    def jitted(self, mesh=None, donate_batch: bool = False) -> Callable:
+        """Compiled ``batch -> output`` closure over the variables.
+
+        With a mesh, inputs are sharded batch-wise over ``data`` and
+        variables are replicated — XLA lays collectives over ICI as needed.
+        Cache key: (mesh, donate) — shape specialization is jit's own cache.
+        """
+        key = (id(mesh) if mesh is not None else None, donate_batch)
+        cached = self._jit_cache.get(key)
+        if cached is not None:
+            return cached
+
+        apply_fn = self.apply_fn
+        if mesh is None:
+            variables = self.variables
+            kwargs: Dict[str, Any] = {"donate_argnums": (1,)} if donate_batch else {}
+            jfn = jax.jit(apply_fn, **kwargs)
+            fn = lambda x: jfn(variables, x)  # noqa: E731
+        else:
+            variables = jax.device_put(self.variables, replicated(mesh))
+            kwargs = {"donate_argnums": (0,)} if donate_batch else {}
+            fn = jax.jit(lambda x: apply_fn(variables, x),
+                         in_shardings=(batch_sharding(mesh),),
+                         out_shardings=batch_sharding(mesh), **kwargs)
+        self._jit_cache[key] = fn
+        return fn
+
+    def apply_batch(self, array: np.ndarray, batch_size: int = 64,
+                    mesh=None) -> np.ndarray:
+        """Run over N rows with fixed-shape padded chunks; returns numpy."""
+        array = np.asarray(array, dtype=self.input_spec.dtype)
+        fn = self.jitted(mesh=mesh)
+        if mesh is not None:
+            # pad batch_size so every data-axis shard is equal
+            from sparkdl_tpu.core.mesh import data_axis_size, pad_to_multiple
+            batch_size = pad_to_multiple(batch_size, data_axis_size(mesh))
+        return batching.run_batched(fn, array, batch_size)
+
+    def __call__(self, x) -> jax.Array:
+        return self.apply_fn(self.variables, x)
+
+    def __repr__(self) -> str:
+        return (f"ModelFunction({self.name}, input={self.input_spec.shape} "
+                f"{self.input_spec.dtype})")
+
+
+# InputModel: the public alias emphasizing the ingestion role (TFInputGraph
+# parity name in this framework's vocabulary).
+InputModel = ModelFunction
+
+
+def _init_template(module, input_spec: TensorSpec):
+    """Abstract variables template (ShapeDtypeStructs) for weight restore.
+
+    eval_shape avoids materializing weights: both flax.from_bytes and Orbax
+    restore only need the pytree structure + leaf shapes/dtypes.
+    """
+    x = jnp.zeros(input_spec.with_batch(1), dtype=input_spec.dtype)
+    return jax.eval_shape(lambda: module.init(jax.random.PRNGKey(0), x))

@@ -326,8 +326,22 @@ class DataFrame:
         return DataFrame.fromArrow(self.toArrow(), numPartitions)
 
     def limit(self, n: int) -> "DataFrame":
-        return DataFrame.fromArrow(self.toArrow().slice(0, n),
-                                   numPartitions=1)
+        """First n rows, materializing only as many partitions as needed."""
+        if self._materialized is not None:
+            return DataFrame.fromArrow(self.toArrow().slice(0, n),
+                                       numPartitions=1)
+        taken: List[pa.RecordBatch] = []
+        count = 0
+        for i, part in enumerate(self._partitions):
+            batch = _run_partition(i, part, self._ops)
+            taken.append(batch)
+            count += batch.num_rows
+            if count >= n:
+                break
+        if not taken:
+            return DataFrame(self._partitions, self._schema, self._ops)
+        table = pa.Table.from_batches(taken, schema=taken[0].schema).slice(0, n)
+        return DataFrame.fromArrow(table, numPartitions=1)
 
     def union(self, other: "DataFrame") -> "DataFrame":
         table = pa.concat_tables([self.toArrow(), other.toArrow()])
@@ -344,8 +358,12 @@ class DataFrame:
 # ---------------------------------------------------------------------------
 
 def _schema_with(schema: pa.Schema, name: str, dtype: pa.DataType) -> pa.Schema:
-    fields = [f for f in schema if f.name != name]
-    return pa.schema(fields + [pa.field(name, dtype)])
+    """Declared schema after with-column: replace in place, append if new
+    (must mirror _set_column's positional behavior)."""
+    if name in schema.names:
+        return pa.schema([pa.field(name, dtype) if f.name == name else f
+                          for f in schema])
+    return pa.schema(list(schema) + [pa.field(name, dtype)])
 
 
 def _set_column(batch: pa.RecordBatch, name: str, arr) -> pa.RecordBatch:

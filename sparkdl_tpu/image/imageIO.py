@@ -118,11 +118,14 @@ def imageStructToArray(imageRow) -> np.ndarray:
 
 def imageStructsToBatchArray(structs: Sequence[dict],
                              target_size: Optional[Tuple[int, int]] = None,
-                             dtype: str = "float32") -> np.ndarray:
+                             dtype: str = "float32",
+                             channels: int = 3) -> np.ndarray:
     """Decode many image structs to one NHWC batch, resizing if needed.
 
     This is the host-side staging step that feeds ``device_put``: output is a
-    single contiguous NHWC array so transfer to HBM is one DMA.
+    single contiguous NHWC array so transfer to HBM is one DMA. Empty input
+    keeps NHWC rank when ``target_size`` is known (empty partitions flow
+    through filter/dropna and must not change rank downstream).
     """
     arrays = []
     for s in structs:
@@ -130,7 +133,12 @@ def imageStructsToBatchArray(structs: Sequence[dict],
         if target_size is not None and arr.shape[:2] != tuple(target_size):
             arr = resizeImageArray(arr, target_size)
         arrays.append(np.asarray(arr, dtype=dtype))
-    return np.stack(arrays) if arrays else np.zeros((0,), dtype=dtype)
+    if arrays:
+        return np.stack(arrays)
+    if target_size is not None:
+        return np.zeros((0, target_size[0], target_size[1], channels),
+                        dtype=dtype)
+    return np.zeros((0,), dtype=dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -241,21 +249,25 @@ def readImagesWithCustomFn(path: str, decode_f: Callable[[bytes], Optional[np.nd
 
     files = listImageFiles(path)
 
-    def load(fpath: str):
+    def load(uri: str):
         try:
-            with open(fpath, "rb") as f:
+            with open(stripFileScheme(uri), "rb") as f:
                 raw = f.read()
         except OSError:
             return None
         arr = decode_f(raw)
         if arr is None:
             return None
-        return imageArrayToStruct(np.asarray(arr), origin="file:" + fpath)
+        return imageArrayToStruct(np.asarray(arr), origin=uri)
 
-    rows = [{"filePath": "file:" + f, "image": load(f)} for f in files]
-    schema = pa.schema([pa.field("filePath", pa.string()),
-                        pa.field("image", imageSchema)])
-    return edf.DataFrame.fromRows(rows, schema=schema, numPartitions=numPartition)
+    # Only the (cheap) file listing is eager; decode runs lazily inside the
+    # engine's partition-parallel, retry-guarded withColumn op.
+    paths_df = edf.DataFrame.fromRows(
+        [{"filePath": "file:" + f} for f in files],
+        schema=pa.schema([pa.field("filePath", pa.string())]),
+        numPartitions=numPartition)
+    return paths_df.withColumn("image", load, inputCols=["filePath"],
+                               outputType=imageSchema)
 
 
 def readImages(path: str, numPartition: Optional[int] = None):

@@ -1,0 +1,29 @@
+"""Flax model zoo: the reference's named CNN families, TPU-native.
+
+Parity: ``sparkdl/transformers/keras_applications.py`` + Scala
+``Models.scala`` (SURVEY.md §2.1/§2.2). All models are NHWC flax.linen
+modules with optional bf16 compute (``dtype=jnp.bfloat16`` — fp32 params,
+MXU-friendly activations).
+"""
+
+from sparkdl_tpu.models.inception import InceptionV3
+from sparkdl_tpu.models.mobilenet import MobileNetV2
+from sparkdl_tpu.models.resnet import ResNet, ResNet50, ResNet101, ResNet152
+from sparkdl_tpu.models.testnet import TestNet
+from sparkdl_tpu.models.vgg import VGG, VGG16, VGG19
+from sparkdl_tpu.models.xception import Xception
+from sparkdl_tpu.models.registry import (
+    SUPPORTED_MODELS,
+    SUPPORTED_MODEL_NAMES,
+    ModelSpec,
+    build_featurizer,
+    build_predictor,
+    get_model_spec,
+)
+
+__all__ = [
+    "InceptionV3", "MobileNetV2", "ResNet", "ResNet50", "ResNet101",
+    "ResNet152", "TestNet", "VGG", "VGG16", "VGG19", "Xception",
+    "SUPPORTED_MODELS", "SUPPORTED_MODEL_NAMES", "ModelSpec",
+    "build_featurizer", "build_predictor", "get_model_spec",
+]

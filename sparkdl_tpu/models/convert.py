@@ -1,0 +1,246 @@
+"""Keras → Flax weight conversion for the model zoo.
+
+The reference consumed Keras models directly (``KerasImageFileTransformer``
+takes an HDF5 model file; ``DeepImageFeaturizer`` ships frozen graphs —
+SURVEY.md §2.1). The TPU rebuild runs Flax modules, so parity requires a
+faithful weight converter. Layout facts making this mostly copy-through:
+
+- Keras Conv2D kernels are HWIO — exactly flax ``nn.Conv``.
+- Keras DepthwiseConv2D kernels are (H, W, C, mult); flax expresses
+  depthwise as ``feature_group_count=C`` with kernel (H, W, 1, C*mult) —
+  a reshape-transpose.
+- Keras BatchNormalization weights are [gamma?, beta?, mean, var] by
+  layer flags → flax params {scale, bias} + batch_stats {mean, var}.
+
+Correspondence is by LAYER NAME for the families with deterministic
+semantic names (ResNet, VGG, MobileNetV2, most of Xception) and by
+build-order (the numeric suffix Keras appends to auto-generated names —
+stable within one model instance) for InceptionV3 and Xception's unnamed
+residual projections. Conversions are validated by the numerical oracle
+tests in tests/models/ (same input through Keras and Flax, outputs equal).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+
+def _suffix_order(name: str) -> int:
+    m = re.search(r"_(\d+)$", name)
+    return int(m.group(1)) if m else 0
+
+
+def _ordered_auto(layers, base: str) -> List:
+    """Layers whose name is ``base`` or ``base_N``, in build (suffix) order."""
+    hits = [l for l in layers
+            if l.name == base or re.fullmatch(re.escape(base) + r"_\d+", l.name)]
+    return sorted(hits, key=lambda l: _suffix_order(l.name))
+
+
+def _put(tree: Dict, path: Tuple[str, ...], leaf_name: str, value) -> None:
+    node = tree
+    for key in path:
+        node = node.setdefault(key, {})
+    node[leaf_name] = np.asarray(value)
+
+
+class _Builder:
+    """Accumulates params/batch_stats trees from keras layers."""
+
+    def __init__(self) -> None:
+        self.params: Dict[str, Any] = {}
+        self.batch_stats: Dict[str, Any] = {}
+
+    def conv(self, layer, *path: str) -> None:
+        weights = layer.get_weights()
+        _put(self.params, path, "kernel", weights[0])
+        if layer.use_bias:
+            _put(self.params, path, "bias", weights[1])
+
+    def depthwise(self, layer, *path: str) -> None:
+        (kernel,) = layer.get_weights()[:1]
+        kh, kw, c, mult = kernel.shape
+        flax_kernel = kernel.transpose(0, 1, 3, 2).reshape(kh, kw, 1, c * mult)
+        _put(self.params, path, "kernel", flax_kernel)
+
+    def separable(self, layer, *path: str) -> None:
+        dw, pw = layer.get_weights()[:2]
+        kh, kw, c, mult = dw.shape
+        _put(self.params, path + ("depthwise",), "kernel",
+             dw.transpose(0, 1, 3, 2).reshape(kh, kw, 1, c * mult))
+        _put(self.params, path + ("pointwise",), "kernel", pw)
+
+    def bn(self, layer, *path: str) -> None:
+        weights = list(layer.get_weights())
+        if layer.scale:
+            _put(self.params, path, "scale", weights.pop(0))
+        if layer.center:
+            _put(self.params, path, "bias", weights.pop(0))
+        _put(self.batch_stats, path, "mean", weights.pop(0))
+        _put(self.batch_stats, path, "var", weights.pop(0))
+
+    def dense(self, layer, *path: str) -> None:
+        weights = layer.get_weights()
+        _put(self.params, path, "kernel", weights[0])
+        if layer.use_bias:
+            _put(self.params, path, "bias", weights[1])
+
+    def variables(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"params": self.params}
+        if self.batch_stats:
+            out["batch_stats"] = self.batch_stats
+        return out
+
+
+def _by_name(keras_model) -> Dict[str, Any]:
+    return {l.name: l for l in keras_model.layers}
+
+
+# ---------------------------------------------------------------------------
+# Per-family converters
+# ---------------------------------------------------------------------------
+
+def convert_inception_v3(keras_model) -> Dict[str, Any]:
+    """conv2d_N / batch_normalization_N build order ↔ cb{i} call order."""
+    import keras as K
+
+    convs = _ordered_auto(
+        [l for l in keras_model.layers if isinstance(l, K.layers.Conv2D)],
+        "conv2d")
+    bns = _ordered_auto(
+        [l for l in keras_model.layers
+         if isinstance(l, K.layers.BatchNormalization)],
+        "batch_normalization")
+    if len(convs) != len(bns):
+        raise ValueError(f"conv/bn count mismatch: {len(convs)} vs {len(bns)}")
+    b = _Builder()
+    for i, (conv, bn_layer) in enumerate(zip(convs, bns)):
+        b.conv(conv, f"cb{i}", "conv")
+        b.bn(bn_layer, f"cb{i}", "bn")
+    layers = _by_name(keras_model)
+    if "predictions" in layers:
+        b.dense(layers["predictions"], "predictions")
+    return b.variables()
+
+
+def convert_resnet(keras_model, stack_sizes=(3, 4, 6, 3)) -> Dict[str, Any]:
+    layers = _by_name(keras_model)
+    b = _Builder()
+    b.conv(layers["conv1_conv"], "conv1_conv")
+    b.bn(layers["conv1_bn"], "conv1_bn")
+    for stage, blocks in enumerate(stack_sizes):
+        s = stage + 2
+        for blk in range(1, blocks + 1):
+            prefix = f"conv{s}_block{blk}"
+            slots = [("0", True)] if blk == 1 else []
+            slots += [("1", False), ("2", False), ("3", False)]
+            for j, _is_shortcut in slots:
+                b.conv(layers[f"{prefix}_{j}_conv"], prefix, f"conv_{j}")
+                b.bn(layers[f"{prefix}_{j}_bn"], prefix, f"bn_{j}")
+    if "predictions" in layers:
+        b.dense(layers["predictions"], "predictions")
+    return b.variables()
+
+
+def convert_vgg(keras_model, convs_per_block=(2, 2, 3, 3, 3)) -> Dict[str, Any]:
+    layers = _by_name(keras_model)
+    b = _Builder()
+    for blk, n in enumerate(convs_per_block, 1):
+        for c in range(1, n + 1):
+            name = f"block{blk}_conv{c}"
+            b.conv(layers[name], name)
+    for name in ("fc1", "fc2", "predictions"):
+        if name in layers:
+            b.dense(layers[name], name)
+    return b.variables()
+
+
+def convert_xception(keras_model) -> Dict[str, Any]:
+    import keras as K
+
+    layers = _by_name(keras_model)
+    b = _Builder()
+    b.conv(layers["block1_conv1"], "block1_conv1")
+    b.bn(layers["block1_conv1_bn"], "block1_conv1_bn")
+    b.conv(layers["block1_conv2"], "block1_conv2")
+    b.bn(layers["block1_conv2_bn"], "block1_conv2_bn")
+    # The four residual projection convs/bns are unnamed in keras source;
+    # build order maps them to blocks 2, 3, 4, 13.
+    res_convs = _ordered_auto(
+        [l for l in keras_model.layers
+         if isinstance(l, K.layers.Conv2D)
+         and not isinstance(l, K.layers.SeparableConv2D)], "conv2d")
+    res_bns = _ordered_auto(
+        [l for l in keras_model.layers
+         if isinstance(l, K.layers.BatchNormalization)],
+        "batch_normalization")
+    for block_id, conv, bn_layer in zip((2, 3, 4, 13), res_convs, res_bns):
+        b.conv(conv, f"block{block_id}_res_conv")
+        b.bn(bn_layer, f"block{block_id}_res_bn")
+    sep_blocks = ([(i, ("sepconv1", "sepconv2")) for i in (2, 3, 4)]
+                  + [(i, ("sepconv1", "sepconv2", "sepconv3"))
+                     for i in range(5, 13)]
+                  + [(13, ("sepconv1", "sepconv2")),
+                     (14, ("sepconv1", "sepconv2"))])
+    for block_id, seps in sep_blocks:
+        for sep in seps:
+            name = f"block{block_id}_{sep}"
+            b.separable(layers[name], name)
+            # flax SeparableConvBN nests its BatchNorm as <name>/bn
+            b.bn(layers[f"{name}_bn"], name, "bn")
+    if "predictions" in layers:
+        b.dense(layers["predictions"], "predictions")
+    return b.variables()
+
+
+def convert_mobilenet_v2(keras_model, num_blocks: int = 17) -> Dict[str, Any]:
+    layers = _by_name(keras_model)
+    b = _Builder()
+    b.conv(layers["Conv1"], "Conv1")
+    b.bn(layers["bn_Conv1"], "Conv1_bn")
+    for bid in range(num_blocks):
+        prefix = "expanded_conv_" if bid == 0 else f"block_{bid}_"
+        flax_block = f"block_{bid}"
+        if bid:
+            b.conv(layers[f"{prefix}expand"], flax_block, "expand")
+            b.bn(layers[f"{prefix}expand_BN"], flax_block, "expand_bn")
+        b.depthwise(layers[f"{prefix}depthwise"], flax_block, "depthwise")
+        b.bn(layers[f"{prefix}depthwise_BN"], flax_block, "depthwise_bn")
+        b.conv(layers[f"{prefix}project"], flax_block, "project")
+        b.bn(layers[f"{prefix}project_BN"], flax_block, "project_bn")
+    b.conv(layers["Conv_1"], "Conv_1")
+    b.bn(layers["Conv_1_bn"], "Conv_1_bn")
+    if "predictions" in layers:
+        b.dense(layers["predictions"], "predictions")
+    return b.variables()
+
+
+_CONVERTERS = {
+    "InceptionV3": convert_inception_v3,
+    "ResNet50": convert_resnet,
+    "Xception": convert_xception,
+    "VGG16": lambda m: convert_vgg(m, (2, 2, 3, 3, 3)),
+    "VGG19": lambda m: convert_vgg(m, (2, 2, 4, 4, 4)),
+    "MobileNetV2": convert_mobilenet_v2,
+}
+
+
+def convert_keras_model(model_name: str, keras_model) -> Dict[str, Any]:
+    """Convert a keras.applications-architecture model to Flax variables."""
+    try:
+        converter = _CONVERTERS[model_name]
+    except KeyError:
+        raise ValueError(
+            f"No converter for {model_name!r}; supported: "
+            f"{sorted(_CONVERTERS)}") from None
+    return converter(keras_model)
+
+
+def load_keras_file(path: str):
+    """Load a Keras model file (H5 / .keras) using the in-env keras."""
+    import keras
+
+    return keras.models.load_model(path, compile=False)

@@ -1,0 +1,131 @@
+"""MobileNetV2 in Flax — keras.applications.mobilenet_v2 parity.
+
+The reference's fine-tune target (BASELINE.json config 4:
+``KerasImageFileEstimator fine-tune MobileNetV2``): 224x224, [-1,1]
+preprocessing, 1280-d features.
+
+Inverted residual blocks per the Keras table; BN eps 1e-3 momentum .999;
+ReLU6; stride-2 depthwise convs use keras ``correct_pad`` + VALID (NOT
+SAME — the asymmetric pad differs).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from sparkdl_tpu.models.layers import (
+    classifier_head, correct_pad, global_avg_pool, pad2d,
+)
+
+MNV2_BN_EPS = 1e-3
+
+
+def _make_divisible(v: float, divisor: int = 8,
+                    min_value: Optional[int] = None) -> int:
+    if min_value is None:
+        min_value = divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+def relu6(x):
+    return jnp.minimum(jnp.maximum(x, 0.0), 6.0)
+
+
+class InvertedResBlock(nn.Module):
+    filters: int
+    stride: int
+    expansion: int
+    alpha: float = 1.0
+    block_id: int = 0
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        bn = lambda name: nn.BatchNorm(  # noqa: E731
+            use_running_average=not train, epsilon=MNV2_BN_EPS,
+            momentum=0.999, dtype=self.dtype, name=name)
+        inputs = x
+        in_ch = x.shape[-1]
+        pointwise = _make_divisible(int(self.filters * self.alpha))
+
+        if self.block_id:
+            x = nn.Conv(self.expansion * in_ch, (1, 1), use_bias=False,
+                        dtype=self.dtype, name="expand")(x)
+            x = relu6(bn("expand_bn")(x))
+
+        if self.stride == 2:
+            x = pad2d(x, correct_pad(x, 3))
+            dw_pad = "VALID"
+        else:
+            dw_pad = "SAME"
+        ch = x.shape[-1]
+        x = nn.Conv(ch, (3, 3), strides=(self.stride, self.stride),
+                    padding=dw_pad, feature_group_count=ch, use_bias=False,
+                    dtype=self.dtype, name="depthwise")(x)
+        x = relu6(bn("depthwise_bn")(x))
+
+        x = nn.Conv(pointwise, (1, 1), use_bias=False, dtype=self.dtype,
+                    name="project")(x)
+        x = bn("project_bn")(x)
+
+        if in_ch == pointwise and self.stride == 1:
+            return inputs + x
+        return x
+
+
+# (filters, stride, expansion) per block, keras order.
+MNV2_BLOCKS = (
+    (16, 1, 1),
+    (24, 2, 6), (24, 1, 6),
+    (32, 2, 6), (32, 1, 6), (32, 1, 6),
+    (64, 2, 6), (64, 1, 6), (64, 1, 6), (64, 1, 6),
+    (96, 1, 6), (96, 1, 6), (96, 1, 6),
+    (160, 2, 6), (160, 1, 6), (160, 1, 6),
+    (320, 1, 6),
+)
+
+
+class MobileNetV2(nn.Module):
+    alpha: float = 1.0
+    include_top: bool = True
+    classes: int = 1000
+    classifier_activation: Optional[str] = "softmax"
+    pooling: Optional[str] = "avg"
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        bn = lambda name: nn.BatchNorm(  # noqa: E731
+            use_running_average=not train, epsilon=MNV2_BN_EPS,
+            momentum=0.999, dtype=self.dtype, name=name)
+
+        first = _make_divisible(32 * self.alpha)
+        x = nn.Conv(first, (3, 3), strides=(2, 2), padding="SAME",
+                    use_bias=False, dtype=self.dtype, name="Conv1")(x)
+        x = relu6(bn("Conv1_bn")(x))
+
+        for bid, (f, s, e) in enumerate(MNV2_BLOCKS):
+            x = InvertedResBlock(f, s, e, alpha=self.alpha, block_id=bid,
+                                 dtype=self.dtype, name=f"block_{bid}")(
+                                     x, train)
+
+        last = _make_divisible(1280 * self.alpha) if self.alpha > 1.0 else 1280
+        x = nn.Conv(last, (1, 1), use_bias=False, dtype=self.dtype,
+                    name="Conv_1")(x)
+        x = relu6(bn("Conv_1_bn")(x))
+
+        if self.include_top:
+            x = global_avg_pool(x)
+            return classifier_head(x, self.classes,
+                                   self.classifier_activation, self.dtype)
+        if self.pooling == "avg":
+            return global_avg_pool(x)
+        if self.pooling == "max":
+            return jnp.max(x, axis=(1, 2))
+        return x

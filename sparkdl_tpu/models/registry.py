@@ -1,0 +1,201 @@
+"""Named-model registry — the reference's ``keras_applications.py`` +
+Scala ``Models.scala`` rebuilt (SURVEY.md §2.1/§2.2).
+
+Each entry carries: the Flax module builder, fixed input size, the
+device-side preprocessing function (fused into the same XLA program as the
+model — the ``buildSpImageConverter`` splice, SURVEY.md §3.2), feature
+dimension, and how to obtain weights. Weight sources:
+
+- ``"random"``: seeded init (tests / no-network environments),
+- a Flax variables dict,
+- a Keras model object or H5/.keras file (converted via models.convert),
+- a msgpack/Orbax path saved by this framework.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparkdl_tpu.core.model_function import ModelFunction, TensorSpec
+from sparkdl_tpu.models.inception import InceptionV3
+from sparkdl_tpu.models.mobilenet import MobileNetV2
+from sparkdl_tpu.models.resnet import ResNet50, ResNet101, ResNet152
+from sparkdl_tpu.models.testnet import TestNet
+from sparkdl_tpu.models.vgg import VGG16, VGG19
+from sparkdl_tpu.models.xception import Xception
+
+# ---------------------------------------------------------------------------
+# Device-side preprocessing (input: float32 RGB in [0, 255], NHWC)
+# ---------------------------------------------------------------------------
+
+_CAFFE_MEAN = (103.939, 116.779, 123.68)  # BGR means, keras 'caffe' mode
+
+
+def preprocess_tf_mode(x: jnp.ndarray) -> jnp.ndarray:
+    """keras 'tf' mode: scale to [-1, 1]."""
+    return x / 127.5 - 1.0
+
+
+def preprocess_caffe_mode(x: jnp.ndarray) -> jnp.ndarray:
+    """keras 'caffe' mode: RGB->BGR, subtract ImageNet means."""
+    x = x[..., ::-1]
+    mean = jnp.asarray(_CAFFE_MEAN, dtype=x.dtype)
+    return x - mean
+
+
+def preprocess_identity(x: jnp.ndarray) -> jnp.ndarray:
+    return x
+
+
+@dataclass(frozen=True)
+class ModelSpec:
+    name: str
+    builder: Callable[..., Any]          # kwargs -> flax Module
+    input_size: Tuple[int, int]          # (H, W)
+    preprocess: Callable                 # device-side, jax-traceable
+    feature_dim: int
+    classes: int = 1000
+    # kwargs used to build the *featurize* (headless) variant
+    featurize_kwargs: Optional[Dict[str, Any]] = None
+
+
+SUPPORTED_MODELS: Dict[str, ModelSpec] = {
+    "InceptionV3": ModelSpec(
+        "InceptionV3", InceptionV3, (299, 299), preprocess_tf_mode, 2048),
+    "ResNet50": ModelSpec(
+        "ResNet50", ResNet50, (224, 224), preprocess_caffe_mode, 2048),
+    "ResNet101": ModelSpec(
+        "ResNet101", ResNet101, (224, 224), preprocess_caffe_mode, 2048),
+    "ResNet152": ModelSpec(
+        "ResNet152", ResNet152, (224, 224), preprocess_caffe_mode, 2048),
+    "Xception": ModelSpec(
+        "Xception", Xception, (299, 299), preprocess_tf_mode, 2048),
+    "VGG16": ModelSpec(
+        "VGG16", VGG16, (224, 224), preprocess_caffe_mode, 4096,
+        featurize_kwargs={"include_top": True, "features_at_fc2": True}),
+    "VGG19": ModelSpec(
+        "VGG19", VGG19, (224, 224), preprocess_caffe_mode, 4096,
+        featurize_kwargs={"include_top": True, "features_at_fc2": True}),
+    "MobileNetV2": ModelSpec(
+        "MobileNetV2", MobileNetV2, (224, 224), preprocess_tf_mode, 1280),
+    "TestNet": ModelSpec(
+        "TestNet", TestNet, (32, 32), preprocess_tf_mode, 16, classes=10),
+}
+
+SUPPORTED_MODEL_NAMES = sorted(SUPPORTED_MODELS)
+
+# keras.applications builders for weight-bearing named models (used when the
+# user asks for keras-initialized weights, or in oracle tests).
+_KERAS_BUILDERS = {
+    "InceptionV3": ("inception_v3", "InceptionV3"),
+    "ResNet50": ("resnet", "ResNet50"),
+    "Xception": ("xception", "Xception"),
+    "VGG16": ("vgg16", "VGG16"),
+    "VGG19": ("vgg19", "VGG19"),
+    "MobileNetV2": ("mobilenet_v2", "MobileNetV2"),
+}
+
+
+def get_model_spec(name: str) -> ModelSpec:
+    try:
+        return SUPPORTED_MODELS[name]
+    except KeyError:
+        raise ValueError(
+            f"Unsupported model {name!r}; supported: {SUPPORTED_MODEL_NAMES}"
+        ) from None
+
+
+def _resolve_variables(spec: ModelSpec, module, weights, seed: int,
+                       input_spec: TensorSpec):
+    """Resolve the ``weights`` argument to a Flax variables pytree."""
+    if weights is None or weights == "random":
+        rng = jax.random.PRNGKey(seed)
+        return module.init(rng, jnp.zeros(input_spec.with_batch(1),
+                                          dtype=input_spec.dtype))
+    if isinstance(weights, dict):
+        return weights
+    if isinstance(weights, str):
+        if os.path.isdir(weights):
+            import orbax.checkpoint as ocp
+
+            template = jax.eval_shape(
+                lambda: module.init(jax.random.PRNGKey(0),
+                                    jnp.zeros(input_spec.with_batch(1),
+                                              dtype=input_spec.dtype)))
+            with ocp.StandardCheckpointer() as ckptr:
+                return ckptr.restore(os.path.abspath(weights), template)
+        if weights.endswith((".h5", ".keras")):
+            from sparkdl_tpu.models.convert import (
+                convert_keras_model, load_keras_file)
+
+            return convert_keras_model(spec.name, load_keras_file(weights))
+        # msgpack
+        import flax.serialization as fser
+
+        template = module.init(jax.random.PRNGKey(0),
+                               jnp.zeros(input_spec.with_batch(1),
+                                         dtype=input_spec.dtype))
+        with open(weights, "rb") as f:
+            return fser.from_bytes(template, f.read())
+    # keras model object
+    if hasattr(weights, "layers"):
+        from sparkdl_tpu.models.convert import convert_keras_model
+
+        return convert_keras_model(spec.name, weights)
+    raise TypeError(f"Cannot resolve weights from {type(weights).__name__}")
+
+
+def _spec_input(spec: ModelSpec) -> TensorSpec:
+    h, w = spec.input_size
+    return TensorSpec((None, h, w, 3), "float32")
+
+
+def build_featurizer(name: str, weights="random", seed: int = 0,
+                     dtype=None, preprocess: bool = True) -> ModelFunction:
+    """Headless named model as a ModelFunction emitting feature vectors.
+
+    Input contract: float32 RGB [0,255] NHWC at the model's input size
+    (host side resizes; scaling/mean-subtract runs on device, fused).
+    """
+    spec = get_model_spec(name)
+    kwargs = dict(spec.featurize_kwargs or {"include_top": False,
+                                            "pooling": "avg"})
+    kwargs["dtype"] = dtype
+    module = spec.builder(**kwargs)
+    input_spec = _spec_input(spec)
+    variables = _resolve_variables(spec, module, weights, seed, input_spec)
+    mf = ModelFunction.fromFlax(module, variables, input_spec,
+                                name=f"{name}_featurize", train=False)
+    if preprocess:
+        mf = mf.with_preprocess(spec.preprocess)
+    return mf
+
+
+def build_predictor(name: str, weights="random", seed: int = 0,
+                    dtype=None, preprocess: bool = True) -> ModelFunction:
+    """Full named model (softmax probabilities) as a ModelFunction."""
+    spec = get_model_spec(name)
+    module = spec.builder(include_top=True, classes=spec.classes, dtype=dtype)
+    input_spec = _spec_input(spec)
+    variables = _resolve_variables(spec, module, weights, seed, input_spec)
+    mf = ModelFunction.fromFlax(module, variables, input_spec,
+                                name=f"{name}_predict", train=False)
+    if preprocess:
+        mf = mf.with_preprocess(spec.preprocess)
+    return mf
+
+
+def build_keras_reference(name: str):
+    """Instantiate the same architecture in keras (weights=None) — used by
+    oracle tests and by users wanting keras-side verification."""
+    import importlib
+
+    module_name, attr = _KERAS_BUILDERS[name]
+    mod = importlib.import_module(f"keras.applications.{module_name}")
+    return getattr(mod, attr)(weights=None)

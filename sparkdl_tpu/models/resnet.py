@@ -1,0 +1,105 @@
+"""ResNet50/101/152 (v1) in Flax — keras.applications.resnet parity.
+
+Reference behavior (upstream ``sparkdl/transformers/keras_applications.py``
+named-model registry, SURVEY.md §2.1): ResNet50 at 224x224, caffe-style
+preprocessing, feature layer = global-average-pooled 2048-d vector.
+
+Architecture matched op-for-op against keras.src.applications.resnet (BN
+eps 1.001e-5, biased convs, stride-2 on the FIRST 1x1 of each downsampling
+block, explicit 3px stem pad then VALID conv — not SAME).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from sparkdl_tpu.models.layers import (
+    RESNET_BN_EPS, classifier_head, global_avg_pool, max_pool, pad2d,
+)
+
+
+class ResidualBlockV1(nn.Module):
+    filters: int
+    stride: int = 1
+    conv_shortcut: bool = True
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        bn = lambda name: nn.BatchNorm(  # noqa: E731
+            use_running_average=not train, epsilon=RESNET_BN_EPS,
+            momentum=0.99, dtype=self.dtype, name=name)
+        if self.conv_shortcut:
+            shortcut = nn.Conv(4 * self.filters, (1, 1),
+                               strides=(self.stride, self.stride),
+                               dtype=self.dtype, name="conv_0")(x)
+            shortcut = bn("bn_0")(shortcut)
+        else:
+            shortcut = x
+        y = nn.Conv(self.filters, (1, 1), strides=(self.stride, self.stride),
+                    dtype=self.dtype, name="conv_1")(x)
+        y = nn.relu(bn("bn_1")(y))
+        y = nn.Conv(self.filters, (3, 3), padding="SAME", dtype=self.dtype,
+                    name="conv_2")(y)
+        y = nn.relu(bn("bn_2")(y))
+        y = nn.Conv(4 * self.filters, (1, 1), dtype=self.dtype,
+                    name="conv_3")(y)
+        y = bn("bn_3")(y)
+        return nn.relu(shortcut + y)
+
+
+class ResNet(nn.Module):
+    """ResNet v1 family. ``stack_sizes``: blocks per stage."""
+
+    stack_sizes: Sequence[int] = (3, 4, 6, 3)
+    include_top: bool = True
+    classes: int = 1000
+    classifier_activation: Optional[str] = "softmax"
+    pooling: Optional[str] = "avg"
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = pad2d(x, 3)
+        x = nn.Conv(64, (7, 7), strides=(2, 2), padding="VALID",
+                    dtype=self.dtype, name="conv1_conv")(x)
+        x = nn.BatchNorm(use_running_average=not train,
+                         epsilon=RESNET_BN_EPS, momentum=0.99,
+                         dtype=self.dtype, name="conv1_bn")(x)
+        x = nn.relu(x)
+        x = pad2d(x, 1)
+        x = max_pool(x, 3, 2)
+
+        filters = (64, 128, 256, 512)
+        for stage, (f, blocks) in enumerate(zip(filters, self.stack_sizes)):
+            stride1 = 1 if stage == 0 else 2
+            x = ResidualBlockV1(f, stride=stride1, dtype=self.dtype,
+                                name=f"conv{stage + 2}_block1")(x, train)
+            for i in range(2, blocks + 1):
+                x = ResidualBlockV1(f, conv_shortcut=False, dtype=self.dtype,
+                                    name=f"conv{stage + 2}_block{i}")(x, train)
+
+        if self.include_top:
+            x = global_avg_pool(x)
+            return classifier_head(x, self.classes,
+                                   self.classifier_activation, self.dtype)
+        if self.pooling == "avg":
+            return global_avg_pool(x)
+        if self.pooling == "max":
+            return jnp.max(x, axis=(1, 2))
+        return x
+
+
+def ResNet50(**kwargs) -> ResNet:
+    return ResNet(stack_sizes=(3, 4, 6, 3), **kwargs)
+
+
+def ResNet101(**kwargs) -> ResNet:
+    return ResNet(stack_sizes=(3, 4, 23, 3), **kwargs)
+
+
+def ResNet152(**kwargs) -> ResNet:
+    return ResNet(stack_sizes=(3, 8, 36, 3), **kwargs)

@@ -1,0 +1,41 @@
+"""TestNet — tiny deterministic CNN for fast tests.
+
+Parity: the reference packaged a deterministic ``TestNet`` graph resource so
+featurizer tests don't download weights (Scala ``Models.scala``, SURVEY.md
+§2.2/§4). Same idea: a small fixed architecture, seeded init, 32x32 input.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from sparkdl_tpu.models.layers import classifier_head, global_avg_pool
+
+
+class TestNet(nn.Module):
+    include_top: bool = True
+    classes: int = 10
+    classifier_activation: Optional[str] = "softmax"
+    pooling: Optional[str] = "avg"
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(8, (3, 3), strides=(2, 2), padding="SAME",
+                    dtype=self.dtype, name="conv1")(x)
+        x = nn.relu(x)
+        x = nn.Conv(16, (3, 3), strides=(2, 2), padding="SAME",
+                    dtype=self.dtype, name="conv2")(x)
+        x = nn.relu(x)
+        if self.include_top:
+            x = global_avg_pool(x)
+            return classifier_head(x, self.classes,
+                                   self.classifier_activation, self.dtype)
+        if self.pooling == "avg":
+            return global_avg_pool(x)
+        if self.pooling == "max":
+            return jnp.max(x, axis=(1, 2))
+        return x

@@ -1,0 +1,63 @@
+"""VGG16/VGG19 in Flax — keras.applications.vgg16/vgg19 parity.
+
+Named models in the reference registry (SURVEY.md §2.1): 224x224,
+caffe-style preprocessing. The reference's featurize layer for VGG is the
+fc2 4096-d activation (not GAP), so ``include_top=False`` here supports
+``pooling=None/'avg'/'max'`` like Keras, and the registry featurizes VGG
+through the dense head (see registry.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from sparkdl_tpu.models.layers import classifier_head, global_avg_pool
+
+
+class VGG(nn.Module):
+    """``convs_per_block``: e.g. (2, 2, 3, 3, 3) for VGG16."""
+
+    convs_per_block: Sequence[int] = (2, 2, 3, 3, 3)
+    include_top: bool = True
+    classes: int = 1000
+    classifier_activation: Optional[str] = "softmax"
+    pooling: Optional[str] = None
+    # When True and include_top, stop after fc2 (the reference's VGG
+    # featurize layer).
+    features_at_fc2: bool = False
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        filters = (64, 128, 256, 512, 512)
+        for b, (f, n) in enumerate(zip(filters, self.convs_per_block), 1):
+            for c in range(1, n + 1):
+                x = nn.Conv(f, (3, 3), padding="SAME", dtype=self.dtype,
+                            name=f"block{b}_conv{c}")(x)
+                x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+
+        if self.include_top:
+            x = x.reshape(x.shape[0], -1)  # Flatten, keras order (NHWC)
+            x = nn.relu(nn.Dense(4096, dtype=self.dtype, name="fc1")(x))
+            x = nn.relu(nn.Dense(4096, dtype=self.dtype, name="fc2")(x))
+            if self.features_at_fc2:
+                return x
+            return classifier_head(x, self.classes,
+                                   self.classifier_activation, self.dtype)
+        if self.pooling == "avg":
+            return global_avg_pool(x)
+        if self.pooling == "max":
+            return jnp.max(x, axis=(1, 2))
+        return x
+
+
+def VGG16(**kwargs) -> VGG:
+    return VGG(convs_per_block=(2, 2, 3, 3, 3), **kwargs)
+
+
+def VGG19(**kwargs) -> VGG:
+    return VGG(convs_per_block=(2, 2, 4, 4, 4), **kwargs)

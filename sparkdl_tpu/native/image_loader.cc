@@ -247,11 +247,15 @@ bool probe_png(const uint8_t* data, size_t len, int* h, int* w, int* c) {
                nullptr, nullptr);
   *h = static_cast<int>(height);
   *w = static_cast<int>(width);
+  // Must mirror decode_png's normalization: tRNS expands to an alpha
+  // channel there, so probe must count it or the caller's buffer is
+  // undersized (heap overflow in resize).
+  const bool has_trns = png_get_valid(png, info, PNG_INFO_tRNS) != 0;
   switch (color_type) {
-    case PNG_COLOR_TYPE_GRAY: *c = 1; break;
+    case PNG_COLOR_TYPE_GRAY: *c = has_trns ? 2 : 1; break;
     case PNG_COLOR_TYPE_GRAY_ALPHA: *c = 2; break;
     case PNG_COLOR_TYPE_RGB_ALPHA: *c = 4; break;
-    default: *c = png_get_valid(png, info, PNG_INFO_tRNS) ? 4 : 3; break;
+    default: *c = has_trns ? 4 : 3; break;  // palette/RGB
   }
   png_destroy_read_struct(&png, &info, nullptr);
   return true;

@@ -129,6 +129,11 @@ class CanLoadImage(Params):
         self._setDefault(imageLoader=None)
 
     def setImageLoader(self, value: Optional[Callable]) -> "CanLoadImage":
+        if value is None:
+            # _set skips None (keyword_only ctor semantics); an explicit
+            # None here means "back to the default decode+resize".
+            self.clear(self.imageLoader)
+            return self
         return self._set(imageLoader=value)
 
     def getImageLoader(self) -> Optional[Callable]:

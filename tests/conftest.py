@@ -9,11 +9,19 @@ imported, hence they live at module import time in conftest.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The Axon TPU environment registers its PJRT plugin from sitecustomize
+# (which runs before conftest) and pins jax_platforms=axon in-config, so the
+# env var alone is not enough — override the config too, before any backend
+# is initialized.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 # Repo root on sys.path so `import sparkdl_tpu` works without install.
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

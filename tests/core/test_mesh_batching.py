@@ -1,0 +1,63 @@
+"""Mesh construction + static batching tests (8 virtual CPU devices)."""
+
+import jax
+import numpy as np
+import pytest
+
+from sparkdl_tpu.core import MeshConfig, make_mesh, shard_batch
+from sparkdl_tpu.core.batching import iter_batches, pad_batch, run_batched
+
+
+def test_default_mesh_all_data():
+    mesh = make_mesh()
+    assert mesh.shape["data"] == 8
+    assert mesh.shape["model"] == 1
+
+
+def test_mesh_shapes():
+    mesh = make_mesh(MeshConfig(data=2, model=4))
+    assert mesh.shape["data"] == 2 and mesh.shape["model"] == 4
+    mesh2 = make_mesh(MeshConfig(model=2))  # data absorbs -> 4
+    assert mesh2.shape["data"] == 4
+
+
+def test_mesh_invalid_shape_raises():
+    with pytest.raises(ValueError):
+        make_mesh(MeshConfig(data=3, model=3))
+    with pytest.raises(ValueError):
+        make_mesh(MeshConfig(model=5))
+
+
+def test_shard_batch_places_on_data_axis():
+    mesh = make_mesh()
+    arr = np.arange(16 * 3, dtype=np.float32).reshape(16, 3)
+    sharded = shard_batch(mesh, arr)
+    assert sharded.sharding.num_devices == 8
+    np.testing.assert_array_equal(np.asarray(sharded), arr)
+
+
+def test_pad_batch():
+    arr = np.ones((3, 2))
+    padded, n = pad_batch(arr, 5)
+    assert padded.shape == (5, 2) and n == 3
+    assert padded[3:].sum() == 0
+    with pytest.raises(ValueError):
+        pad_batch(np.ones((6, 2)), 5)
+
+
+def test_iter_batches_shapes():
+    chunks = list(iter_batches(np.arange(10).reshape(10, 1), 4))
+    assert [c.shape for c, _ in chunks] == [(4, 1)] * 3
+    assert [v for _, v in chunks] == [4, 4, 2]
+    assert list(iter_batches(np.zeros((0, 1)), 4)) == []
+
+
+def test_run_batched_concatenates():
+    arr = np.arange(10, dtype=np.float32).reshape(10, 1)
+    out = run_batched(lambda b: b * 2, arr, batch_size=3)
+    np.testing.assert_array_equal(out, arr * 2)
+
+
+def test_run_batched_empty():
+    out = run_batched(lambda b: b, np.zeros((0, 4), np.float32), 3)
+    assert out.shape[0] == 0

@@ -1,0 +1,113 @@
+"""ModelFunction tests, incl. the ingestion format-matrix (SURVEY.md §4):
+one tiny model exported every way, identical results through each ctor."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparkdl_tpu.core import (
+    MeshConfig, ModelFunction, TensorSpec, make_mesh,
+)
+
+
+class TinyNet(nn.Module):
+    features: int = 5
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Dense(8)(x)
+        x = nn.relu(x)
+        return nn.Dense(self.features)(x)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    module = TinyNet()
+    spec = TensorSpec((None, 3))
+    variables = module.init(jax.random.PRNGKey(0),
+                            jnp.zeros(spec.with_batch(1)))
+    mf = ModelFunction.fromFlax(module, variables, spec)
+    x = np.asarray(jax.random.normal(jax.random.PRNGKey(1), (7, 3)))
+    expected = np.asarray(module.apply(variables, x))
+    return module, spec, variables, mf, x, expected
+
+
+def test_from_flax_matches_direct_apply(tiny):
+    _, _, _, mf, x, expected = tiny
+    np.testing.assert_allclose(np.asarray(mf(x)), expected, rtol=1e-6)
+
+
+def test_apply_batch_pads_and_unpads(tiny):
+    _, _, _, mf, x, expected = tiny
+    out = mf.apply_batch(x, batch_size=4)  # 7 rows -> chunks 4 + 3(padded)
+    assert out.shape == expected.shape
+    np.testing.assert_allclose(out, expected, rtol=1e-5)
+
+
+def test_format_matrix_equivalence(tiny, tmp_path):
+    """The TFInputGraph ctor-matrix test: every ingestion route agrees."""
+    module, spec, variables, mf, x, expected = tiny
+
+    routes = {}
+    # fromFunction
+    routes["function"] = ModelFunction.fromFunction(
+        lambda vs, a: module.apply(vs, a), variables, spec)
+    # fromMsgpack
+    mp = tmp_path / "weights.msgpack"
+    mf.toMsgpack(str(mp))
+    routes["msgpack"] = ModelFunction.fromMsgpack(str(mp), module, spec)
+    # fromOrbax
+    od = tmp_path / "orbax_ckpt"
+    mf.toOrbax(str(od))
+    routes["orbax"] = ModelFunction.fromOrbax(str(od), module, spec)
+    # fromJaxExport (symbolic batch dim)
+    blob = mf.toJaxExport()
+    routes["export"] = ModelFunction.fromJaxExport(blob)
+    # fromJaxExport via file, fixed batch
+    ep = tmp_path / "model.stablehlo"
+    mf.toJaxExport(str(ep), batch_size=7)
+    routes["export_file"] = ModelFunction.fromJaxExport(str(ep))
+
+    for name, route in routes.items():
+        out = np.asarray(route(x))
+        np.testing.assert_allclose(out, expected, rtol=1e-5,
+                                   err_msg=f"route {name} diverged")
+
+
+def test_export_symbolic_batch_runs_any_size(tiny):
+    _, _, _, mf, _, _ = tiny
+    exported = ModelFunction.fromJaxExport(mf.toJaxExport())
+    assert exported.input_spec.shape[0] is None
+    for n in (1, 5, 16):
+        out = exported(np.zeros((n, 3), np.float32))
+        assert np.asarray(out).shape == (n, 5)
+
+
+def test_composition_fuses(tiny):
+    _, _, _, mf, x, expected = tiny
+    composed = (mf.with_preprocess(lambda a: a * 2.0)
+                  .with_postprocess(lambda y: y + 1.0))
+    out = np.asarray(composed(x / 2.0))
+    np.testing.assert_allclose(out, expected + 1.0, rtol=1e-5)
+
+
+def test_flattened(tiny):
+    module, spec, variables, mf, x, _ = tiny
+    out = mf.flattened()(x)
+    assert out.ndim == 2
+
+
+def test_mesh_sharded_apply(tiny):
+    _, _, _, mf, x, expected = tiny
+    mesh = make_mesh(MeshConfig(data=8))
+    out = mf.apply_batch(x, batch_size=8, mesh=mesh)
+    np.testing.assert_allclose(out, expected, rtol=1e-5)
+
+
+def test_jit_cache_reused(tiny):
+    _, _, _, mf, x, _ = tiny
+    f1 = mf.jitted()
+    f2 = mf.jitted()
+    assert f1 is f2

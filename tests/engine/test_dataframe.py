@@ -167,3 +167,22 @@ def test_cache_reused_by_derived_frames():
     n_after_cache = calls["n"]
     cached.select("c").collect()
     assert calls["n"] == n_after_cache  # derived frame reused materialization
+
+
+def test_with_column_replace_keeps_position():
+    df = DataFrame.fromRows([{"a": 1, "b": 2}], numPartitions=1)
+    out = df.withColumn("a", lambda a: a * 10, ["a"], pa.int64())
+    assert out.columns == ["a", "b"]
+    assert out.collect() == [{"a": 10, "b": 2}]
+
+
+def test_limit_materializes_only_needed_partitions():
+    calls = {"n": 0}
+
+    def op(batch):
+        calls["n"] += 1
+        return pa.array([1] * batch.num_rows)
+
+    big = DataFrame.fromRows([{"x": i} for i in range(100)], numPartitions=10)
+    assert big.withColumnBatch("y", op, pa.int64()).limit(5).count() == 5
+    assert calls["n"] == 1

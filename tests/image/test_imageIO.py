@@ -96,3 +96,15 @@ def test_decode_image_file_resize(tiny_image_dir):
     files = imageIO.listImageFiles(str(tiny_image_dir))
     arr = imageIO.decodeImageFile(files[0], target_size=(16, 16))
     assert arr.shape == (16, 16, 3) and arr.dtype == np.uint8
+
+
+def test_empty_staging_batch_keeps_nhwc_rank():
+    out = imageIO.imageStructsToBatchArray([], target_size=(8, 8))
+    assert out.shape == (0, 8, 8, 3)
+
+
+def test_read_images_decode_is_lazy_and_parallel(tiny_image_dir):
+    # The reader must not decode at construction time.
+    df = imageIO.readImages(str(tiny_image_dir))
+    assert df._materialized is None  # plan only
+    assert df.count() == 5

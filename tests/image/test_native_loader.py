@@ -90,3 +90,12 @@ def test_batch_grayscale_promoted_to_rgb(rng):
     out = loader.decode_batch([_png_bytes(gray)], (16, 16))
     assert out.shape == (1, 16, 16, 3)
     np.testing.assert_array_equal(out[0, :, :, 0], out[0, :, :, 1])
+
+
+def test_grayscale_png_with_trns_probe_matches_decode(rng):
+    # Regression: probe undercounted channels for gray+tRNS -> heap overflow.
+    arr = rng.integers(0, 255, (16, 16), dtype=np.uint8)
+    buf = BytesIO()
+    Image.fromarray(arr, mode="L").save(buf, format="PNG", transparency=128)
+    out = loader.decode(buf.getvalue())
+    assert out is not None and out.shape == (16, 16, 2)

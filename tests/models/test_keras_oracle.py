@@ -1,0 +1,57 @@
+"""Keras-oracle equivalence tests (SURVEY.md §4 oracle pattern).
+
+For each named model family: build the keras.applications architecture with
+random weights, convert to Flax via models.convert, run the SAME input
+through both, and require matching outputs. This validates architecture
+parity op-for-op AND converter correctness in one shot — the strongest
+offline check available (no pretrained downloads in this environment).
+
+These are the slowest tests in the suite (keras/TF CPU forward); inputs are
+kept tiny (batch 2) and each family runs once.
+"""
+
+import numpy as np
+import pytest
+
+keras = pytest.importorskip("keras")
+
+from sparkdl_tpu.models import registry  # noqa: E402
+from sparkdl_tpu.models.convert import convert_keras_model  # noqa: E402
+from sparkdl_tpu.core.model_function import ModelFunction, TensorSpec  # noqa: E402
+
+# (name, tolerance). BN-heavy deep nets accumulate fp32 reassociation
+# differences; tolerances are per-family, asserted on softmax probabilities
+# and on raw features.
+FAMILIES = [
+    ("InceptionV3", 2e-4),
+    ("ResNet50", 2e-4),
+    ("Xception", 2e-4),
+    ("VGG16", 2e-4),
+    ("VGG19", 2e-4),
+    ("MobileNetV2", 2e-4),
+]
+
+
+def _run_pair(name, tol):
+    spec = registry.get_model_spec(name)
+    h, w = spec.input_size
+    rng = np.random.default_rng(0)
+    x = rng.uniform(-1.0, 1.0, size=(2, h, w, 3)).astype(np.float32)
+
+    kmodel = registry.build_keras_reference(name)
+    expected = np.asarray(kmodel(x))
+
+    variables = convert_keras_model(name, kmodel)
+    module = spec.builder(include_top=True, classes=spec.classes)
+    mf = ModelFunction.fromFlax(module, variables,
+                                TensorSpec((None, h, w, 3)), train=False)
+    got = np.asarray(mf(x))
+
+    assert got.shape == expected.shape
+    np.testing.assert_allclose(got, expected, atol=tol, rtol=1e-3)
+
+
+@pytest.mark.parametrize("name,tol", FAMILIES,
+                         ids=[f[0] for f in FAMILIES])
+def test_keras_oracle(name, tol):
+    _run_pair(name, tol)

@@ -96,3 +96,16 @@ def test_col_map_converters():
         SparkDLTypeConverters.asColumnToInputMap([("a", "b")])
     with pytest.raises(TypeError):
         SparkDLTypeConverters.asOutputToColumnMap({"out": ""})
+
+
+def test_set_image_loader_none_resets():
+    from sparkdl_tpu.param import CanLoadImage
+
+    class L(CanLoadImage):
+        pass
+
+    loader = L()
+    loader.setImageLoader(lambda u: None)
+    assert loader.getImageLoader() is not None
+    loader.setImageLoader(None)
+    assert loader.getImageLoader() is None
