@@ -405,7 +405,9 @@ def column_to_numpy(arr, dtype=None) -> np.ndarray:
         arr = arr.combine_chunks()
     if pa.types.is_fixed_size_list(arr.type):
         k = arr.type.list_size
-        values = arr.values.to_numpy(zero_copy_only=False)
+        # flatten() (not .values): respects slice offsets — partition
+        # batches are table slices, where .values spans the whole buffer.
+        values = arr.flatten().to_numpy(zero_copy_only=False)
         out = values.reshape(len(arr), k)
     elif pa.types.is_list(arr.type) or pa.types.is_large_list(arr.type):
         rows = arr.to_pylist()

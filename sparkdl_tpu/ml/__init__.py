@@ -1,0 +1,36 @@
+"""ML-Pipeline API layer (L4′) — the user-facing surface.
+
+Parity target (SURVEY.md §1 L4, §2.1): the reference exposed Spark ML
+``Transformer``/``Estimator`` subclasses (``DeepImageFeaturizer``,
+``DeepImagePredictor``, ``KerasImageFileTransformer``, ``KerasTransformer``,
+``TFImageTransformer``, ``TFTransformer``, ``KerasImageFileEstimator``).
+This package rebuilds that surface on the in-repo engine with TPU-native
+execution underneath (jitted Flax apply instead of TF sessions).
+"""
+
+from sparkdl_tpu.ml.base import (
+    Estimator,
+    Model,
+    Pipeline,
+    PipelineModel,
+    Transformer,
+)
+from sparkdl_tpu.ml.image_transformer import TPUImageTransformer
+from sparkdl_tpu.ml.tensor_transformer import TPUTransformer
+
+# Reference-compatible aliases: the reference's names execute TF graphs;
+# here the payload is a ModelFunction, but the pipeline role is identical.
+TFImageTransformer = TPUImageTransformer
+TFTransformer = TPUTransformer
+
+__all__ = [
+    "Estimator",
+    "Model",
+    "Pipeline",
+    "PipelineModel",
+    "Transformer",
+    "TPUImageTransformer",
+    "TPUTransformer",
+    "TFImageTransformer",
+    "TFTransformer",
+]

@@ -1,0 +1,156 @@
+"""TPUImageTransformer — arbitrary model applied to an image column.
+
+Parity: the reference's workhorse ``TFImageTransformer``
+(``transformers/tf_image.py``, SURVEY.md §2.1, §3.2). There the graph
+pipeline was assembled by splicing TF graph pieces (``buildSpImageConverter``
+in front, flattener behind) and executed per-partition by TensorFrames→JNI.
+Here the same pipeline is function composition compiled into ONE XLA
+program:
+
+    host: image struct column → contiguous NHWC batch (resize if needed)
+    device (one jit): cast → user/device preprocess → model → [flatten]
+
+and execution is the engine's partition-parallel ``withColumnBatch`` — one
+``device_put`` per partition chunk, fixed batch shapes via padding so XLA
+compiles once per batch size.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from sparkdl_tpu.engine.dataframe import fixed_size_list_array
+from sparkdl_tpu.image import imageIO
+from sparkdl_tpu.ml.base import Transformer
+from sparkdl_tpu.param.base import Param, keyword_only
+from sparkdl_tpu.param.converters import TypeConverters
+from sparkdl_tpu.param.shared_params import (
+    HasBatchSize,
+    HasInputCol,
+    HasModelFunction,
+    HasOutputCol,
+    HasOutputMode,
+)
+
+OUTPUT_MODES = ("vector", "image")
+
+
+class TPUImageTransformer(Transformer, HasInputCol, HasOutputCol,
+                          HasModelFunction, HasOutputMode, HasBatchSize):
+    """Apply a ModelFunction to an image-struct column.
+
+    ``outputMode="vector"`` flattens model output per row into a fixed-size
+    float list column (the reference's Spark-ML Vector analog);
+    ``outputMode="image"`` re-wraps 3-D HWC output as image structs
+    (parity with ``tf_image.py``'s two output modes).
+    """
+
+    inputSize = Param(
+        "TPUImageTransformer", "inputSize",
+        "(H, W) the host resizes images to before staging; None uses the "
+        "model input spec's spatial dims",
+        typeConverter=TypeConverters.identity)
+
+    @keyword_only
+    def __init__(self, *, inputCol: Optional[str] = None,
+                 outputCol: Optional[str] = None,
+                 modelFunction=None,
+                 outputMode: str = "vector",
+                 batchSize: int = 64,
+                 inputSize: Optional[Tuple[int, int]] = None) -> None:
+        super().__init__()
+        self._setDefault(outputMode="vector", batchSize=64, inputSize=None)
+        kwargs = self._input_kwargs
+        self.setParams(**kwargs)
+
+    @keyword_only
+    def setParams(self, *, inputCol: Optional[str] = None,
+                  outputCol: Optional[str] = None,
+                  modelFunction=None,
+                  outputMode: str = "vector",
+                  batchSize: int = 64,
+                  inputSize: Optional[Tuple[int, int]] = None
+                  ) -> "TPUImageTransformer":
+        # outputMode validation lives in the param's typeConverter
+        # (SparkDLTypeConverters.toOutputMode) so every set path is covered.
+        return self._set(**self._input_kwargs)
+
+    def setInputSize(self, value) -> "TPUImageTransformer":
+        return self._set(inputSize=value)
+
+    def getInputSize(self):
+        return self.getOrDefault(self.inputSize)
+
+    # -- execution -----------------------------------------------------------
+
+    def _target_size(self, model) -> Optional[Tuple[int, int]]:
+        size = self.getOrDefault(self.inputSize)
+        if size is not None:
+            return tuple(size)
+        shape = model.input_spec.shape
+        if len(shape) == 4 and shape[1] is not None and shape[2] is not None:
+            return (shape[1], shape[2])
+        return None
+
+    def _transform(self, dataset):
+        model = self.getModelFunction()
+        if model is None:
+            raise ValueError("modelFunction must be set")
+        input_col = self.getInputCol()
+        output_col = self.getOutputCol()
+        mode = self.getOutputMode()
+        batch_size = self.getBatchSize()
+        target_size = self._target_size(model)
+        run = model.flattened() if mode == "vector" else model
+        if input_col not in dataset.columns:
+            raise KeyError(f"No such column: {input_col!r}")
+
+        def apply_partition(batch: pa.RecordBatch) -> pa.Array:
+            idx = batch.schema.get_field_index(input_col)
+            structs = batch.column(idx).to_pylist()
+            valid = [i for i, s in enumerate(structs) if s is not None]
+            if not valid:
+                out_type = (pa.list_(pa.float32()) if mode == "vector"
+                            else imageIO.imageSchema)
+                return pa.array([None] * batch.num_rows, type=out_type)
+            stacked = imageIO.imageStructsToBatchArray(
+                [structs[i] for i in valid], target_size=target_size,
+                dtype=model.input_spec.dtype)
+            out = run.apply_batch(stacked, batch_size=batch_size)
+            if mode == "vector":
+                return _vectors_with_nulls(out, valid, batch.num_rows)
+            return _images_with_nulls(out, valid, batch.num_rows,
+                                      [structs[i].get("origin", "") for i in valid])
+
+        out_type = (pa.list_(pa.float32())
+                    if mode == "vector" else imageIO.imageSchema)
+        return dataset.withColumnBatch(output_col, apply_partition,
+                                       outputType=out_type)
+
+
+def _vectors_with_nulls(out: np.ndarray, valid, num_rows: int) -> pa.Array:
+    out = np.asarray(out, dtype=np.float32).reshape(len(valid), -1)
+    if len(valid) == num_rows:
+        return fixed_size_list_array(out).cast(pa.list_(pa.float32()))
+    values = [None] * num_rows
+    for j, i in enumerate(valid):
+        values[i] = out[j]
+    return pa.array(values, type=pa.list_(pa.float32()))
+
+
+def _images_with_nulls(out: np.ndarray, valid, num_rows: int,
+                       origins) -> pa.Array:
+    out = np.asarray(out)
+    if out.ndim != 4:
+        raise ValueError(
+            f"outputMode='image' needs NHWC model output, got shape {out.shape}")
+    values = [None] * num_rows
+    for j, i in enumerate(valid):
+        arr = out[j]
+        if arr.dtype not in (np.uint8, np.float32):
+            arr = arr.astype(np.float32)
+        values[i] = imageIO.imageArrayToStruct(arr, origin=origins[j])
+    return pa.array(values, type=imageIO.imageSchema)

@@ -1,0 +1,91 @@
+"""TPUTransformer — arbitrary model over numeric array/scalar columns.
+
+Parity: the reference's ``TFTransformer`` (``transformers/tf_tensor.py``,
+SURVEY.md §2.1) which mapped Spark rows → numpy blocks → ``sess.run`` →
+output column. Here: Arrow FixedSizeList / numeric column → contiguous
+numpy block (zero-copy where Arrow allows) → jitted ModelFunction with
+padded static batch shapes → list<float32> output column.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import pyarrow as pa
+
+from sparkdl_tpu.engine.dataframe import column_to_numpy, fixed_size_list_array
+from sparkdl_tpu.ml.base import Transformer
+from sparkdl_tpu.param.base import keyword_only
+from sparkdl_tpu.param.shared_params import (
+    HasBatchSize,
+    HasInputCol,
+    HasModelFunction,
+    HasOutputCol,
+)
+
+
+def column_to_block(column: pa.Array, element_shape) -> np.ndarray:
+    """Arrow column → (N, *element_shape) contiguous numpy block.
+
+    Conversion is the engine's ``column_to_numpy`` (FixedSizeList/List/
+    numeric); this adds the model-input contract: row length must match the
+    input spec's element size — rows are reshaped, never resized.
+    """
+    values = column_to_numpy(column)
+    n = len(column)
+    want = int(np.prod(element_shape)) if element_shape else 1
+    if values.ndim == 1 and want != 1:
+        raise ValueError(
+            f"scalar input column for model expecting {element_shape}")
+    if values.size != n * want:
+        raise ValueError(
+            f"input rows have {values.size // max(n, 1)} elements, model "
+            f"expects {want}")
+    return np.ascontiguousarray(values).reshape((n,) + tuple(element_shape))
+
+
+class TPUTransformer(Transformer, HasInputCol, HasOutputCol,
+                     HasModelFunction, HasBatchSize):
+    """Apply a ModelFunction to a numeric column, emitting list<float32>."""
+
+    @keyword_only
+    def __init__(self, *, inputCol: Optional[str] = None,
+                 outputCol: Optional[str] = None,
+                 modelFunction=None,
+                 batchSize: int = 64) -> None:
+        super().__init__()
+        self._setDefault(batchSize=64)
+        kwargs = self._input_kwargs
+        self.setParams(**kwargs)
+
+    @keyword_only
+    def setParams(self, *, inputCol: Optional[str] = None,
+                  outputCol: Optional[str] = None,
+                  modelFunction=None,
+                  batchSize: int = 64) -> "TPUTransformer":
+        return self._set(**self._input_kwargs)
+
+    def _transform(self, dataset):
+        model = self.getModelFunction()
+        if model is None:
+            raise ValueError("modelFunction must be set")
+        input_col = self.getInputCol()
+        output_col = self.getOutputCol()
+        batch_size = self.getBatchSize()
+        element_shape = model.input_spec.element_shape
+        if input_col not in dataset.columns:
+            raise KeyError(f"No such column: {input_col!r}")
+
+        def apply_partition(batch: pa.RecordBatch) -> pa.Array:
+            if batch.num_rows == 0:
+                return pa.array([], type=pa.list_(pa.float32()))
+            col = batch.column(batch.schema.get_field_index(input_col))
+            block = column_to_block(col, element_shape)
+            block = block.astype(model.input_spec.dtype, copy=False)
+            out = model.apply_batch(block, batch_size=batch_size)
+            out = np.asarray(out, dtype=np.float32).reshape(batch.num_rows, -1)
+            return fixed_size_list_array(out).cast(pa.list_(pa.float32()))
+
+        return dataset.withColumnBatch(output_col, apply_partition,
+                                       outputType=pa.list_(pa.float32()))

@@ -205,8 +205,12 @@ class Params:
         that._params_cache = None
         that._copy_params_keep_uid()
         if extra:
+            # pyspark semantics: extra entries whose param the new instance
+            # does not own are silently ignored (lets one param map fan out
+            # across pipeline stages, each taking only what it owns).
             for param, value in extra.items():
-                that._paramMap[that.getParam(param.name)] = value
+                if isinstance(param, Param) and that.hasParam(param.name):
+                    that._paramMap[that.getParam(param.name)] = value
         return that
 
     def _copy_params_keep_uid(self) -> None:

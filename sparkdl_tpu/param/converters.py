@@ -111,10 +111,10 @@ class SparkDLTypeConverters:
     @staticmethod
     def toModelFunction(value: Any):
         """Validate a ModelFunction-like object (duck-typed to avoid cycles)."""
-        if hasattr(value, "apply") and hasattr(value, "variables"):
+        if hasattr(value, "apply_fn") and hasattr(value, "variables"):
             return value
         raise TypeError(
-            f"Expected a ModelFunction (has .apply/.variables), got {type(value).__name__}")
+            f"Expected a ModelFunction (has .apply_fn/.variables), got {type(value).__name__}")
 
     @staticmethod
     def supportedNameConverter(supportedList: List[str]):
@@ -134,6 +134,6 @@ class SparkDLTypeConverters:
     @staticmethod
     def toOutputMode(value: Any) -> str:
         mode = TypeConverters.toString(value)
-        if mode not in ("vector", "image", "tensor"):
-            raise TypeError(f"outputMode must be 'vector', 'image' or 'tensor', got {mode!r}")
+        if mode not in ("vector", "image"):
+            raise TypeError(f"outputMode must be 'vector' or 'image', got {mode!r}")
         return mode

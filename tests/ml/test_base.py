@@ -1,0 +1,162 @@
+"""Pipeline/fit/transform/paramMap semantics tests.
+
+Models the reference's reliance on pyspark.ml semantics (SURVEY.md §7 hard
+part #4): copy-on-override, fitMultiple laziness/thread-safety, pipeline
+stage fitting order.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from sparkdl_tpu.engine.dataframe import DataFrame
+from sparkdl_tpu.ml.base import Estimator, Model, Pipeline, Transformer
+from sparkdl_tpu.param.base import Param, keyword_only
+from sparkdl_tpu.param.converters import TypeConverters
+
+
+class AddConst(Transformer):
+    value = Param("AddConst", "value", "", typeConverter=TypeConverters.toFloat)
+
+    @keyword_only
+    def __init__(self, *, value=1.0, inputCol="x", outputCol="y"):
+        super().__init__()
+        self._set(**self._input_kwargs)
+        self._in = inputCol
+        self._out = outputCol
+
+    def _transform(self, dataset):
+        v = self.getOrDefault(self.value)
+        return dataset.withColumn(self._out, lambda x: x + v,
+                                  inputCols=[self._in])
+
+
+class MeanEstimator(Estimator):
+    """Learns the mean of column x; model subtracts it."""
+
+    shift = Param("MeanEstimator", "shift", "", typeConverter=TypeConverters.toFloat)
+
+    @keyword_only
+    def __init__(self, *, shift=0.0):
+        super().__init__()
+        self._setDefault(shift=0.0)
+        self._set(**self._input_kwargs)
+        self.fit_count = 0
+
+    def _fit(self, dataset):
+        self.fit_count += 1
+        xs = [r["x"] for r in dataset.collect()]
+        mean = float(np.mean(xs)) + self.getOrDefault(self.shift)
+        return MeanModel(mean)._set_parent(self)
+
+
+class MeanModel(Model):
+    def __init__(self, mean):
+        super().__init__()
+        self.mean = mean
+
+    def _transform(self, dataset):
+        return dataset.withColumn("centered", lambda x: x - self.mean,
+                                  inputCols=["x"])
+
+    def copy(self, extra=None):
+        m = MeanModel(self.mean)
+        m.parent = self.parent
+        return m
+
+
+@pytest.fixture
+def df():
+    return DataFrame.fromColumns({"x": np.array([1.0, 2.0, 3.0, 4.0])},
+                                 numPartitions=2)
+
+
+def test_transform_with_params_does_not_mutate(df):
+    t = AddConst(value=1.0)
+    out = t.transform(df, {t.value: 10.0}).collect()
+    assert [r["y"] for r in out] == [11.0, 12.0, 13.0, 14.0]
+    # receiver unchanged
+    out2 = t.transform(df).collect()
+    assert [r["y"] for r in out2] == [2.0, 3.0, 4.0, 5.0]
+
+
+def test_fit_with_single_param_map(df):
+    est = MeanEstimator()
+    model = est.fit(df, {est.shift: 1.0})
+    assert model.mean == pytest.approx(3.5)
+    assert est.getOrDefault(est.shift) == 0.0  # estimator untouched
+
+
+def test_fit_with_param_map_list_returns_models_in_order(df):
+    est = MeanEstimator()
+    models = est.fit(df, [{est.shift: 0.0}, {est.shift: 1.0}, {est.shift: 2.0}])
+    assert [m.mean for m in models] == pytest.approx([2.5, 3.5, 4.5])
+
+
+def test_fit_multiple_is_thread_safe(df):
+    est = MeanEstimator()
+    maps = [{est.shift: float(i)} for i in range(8)]
+    it = est.fitMultiple(df, maps)
+    results = {}
+    lock = threading.Lock()
+
+    def drain():
+        while True:
+            try:
+                i, m = next(it)
+            except StopIteration:
+                return
+            with lock:
+                results[i] = m.mean
+
+    threads = [threading.Thread(target=drain) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert results == {i: pytest.approx(2.5 + i) for i in range(8)}
+
+
+def test_pipeline_fits_estimators_on_running_frame(df):
+    # AddConst makes x→y, then estimator fits on x (still present)
+    pipe = Pipeline(stages=[AddConst(value=1.0), MeanEstimator()])
+    pm = pipe.fit(df)
+    assert isinstance(pm.stages[1], MeanModel)
+    out = pm.transform(df).collect()
+    assert [r["centered"] for r in out] == pytest.approx([-1.5, -0.5, 0.5, 1.5])
+
+
+def test_pipeline_estimator_then_transformer_not_fit_eagerly(df):
+    est = MeanEstimator()
+    pipe = Pipeline(stages=[est, AddConst(value=1.0)])
+    pm = pipe.fit(df)
+    # est fit exactly once; AddConst passed through untouched
+    assert est.fit_count == 1
+    out = pm.transform(df).collect()
+    assert [r["y"] for r in out] == [2.0, 3.0, 4.0, 5.0]
+
+
+def test_pipeline_fit_with_stage_param_override(df):
+    # the documented HPO pattern: one param map addressing a stage's param
+    est = MeanEstimator()
+    pipe = Pipeline(stages=[est])
+    pm = pipe.fit(df, {est.shift: 1.0})
+    assert pm.stages[0].mean == pytest.approx(3.5)
+    # estimator itself untouched
+    assert est.getOrDefault(est.shift) == 0.0
+
+
+def test_copy_ignores_unowned_extra_params(df):
+    est = MeanEstimator()
+    t = AddConst(value=1.0)
+    # t does not own est.shift: must be silently ignored, not raise
+    t2 = t.copy({est.shift: 5.0, t.value: 3.0})
+    assert t2.getOrDefault(t2.value) == 3.0
+
+
+def test_pipeline_copy_copies_stages():
+    p = Pipeline(stages=[AddConst(value=2.0)])
+    q = p.copy()
+    assert q.getStages()[0] is not p.getStages()[0]
+    assert q.getStages()[0].getOrDefault(q.getStages()[0].value) == 2.0

@@ -1,0 +1,181 @@
+"""Oracle-equivalence tests for TPUImageTransformer / TPUTransformer.
+
+The reference's load-bearing test pattern (SURVEY.md §4): pipeline output
+must equal running the same model directly on the same inputs. The oracle
+here is plain numpy / direct jax apply on host.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from sparkdl_tpu.core.model_function import ModelFunction, TensorSpec
+from sparkdl_tpu.engine.dataframe import DataFrame
+from sparkdl_tpu.image import imageIO
+from sparkdl_tpu.ml import TFImageTransformer, TFTransformer, TPUImageTransformer, TPUTransformer
+
+
+def _linear_model(in_dim=6, out_dim=3, seed=0):
+    rng = np.random.default_rng(seed)
+    w = rng.normal(size=(in_dim, out_dim)).astype(np.float32)
+    b = rng.normal(size=(out_dim,)).astype(np.float32)
+
+    def apply_fn(vs, x):
+        return x @ vs["w"] + vs["b"]
+
+    mf = ModelFunction.fromFunction(
+        apply_fn, {"w": w, "b": b}, TensorSpec((None, in_dim)))
+    return mf, w, b
+
+
+def _image_model(h=8, w=8, c=3):
+    """Per-image channel means — shape-sensitive enough to catch layout bugs."""
+
+    def apply_fn(_vs, x):
+        return x.mean(axis=(1, 2))
+
+    return ModelFunction.fromFunction(apply_fn, None, TensorSpec((None, h, w, c)))
+
+
+@pytest.fixture
+def image_df(rng):
+    structs = []
+    arrays = []
+    for i in range(7):
+        arr = rng.integers(0, 255, size=(8, 8, 3), dtype=np.uint8)
+        arrays.append(arr)
+        structs.append(imageIO.imageArrayToStruct(arr, origin=f"img{i}"))
+    df = DataFrame.fromRows([{"image": s} for s in structs],
+                            schema=pa.schema([pa.field("image", imageIO.imageSchema)]),
+                            numPartitions=3)
+    return df, arrays
+
+
+def test_tensor_transformer_matches_oracle():
+    mf, w, b = _linear_model()
+    x = np.random.default_rng(1).normal(size=(10, 6)).astype(np.float32)
+    df = DataFrame.fromColumns({"features": x}, numPartitions=3)
+    out = TPUTransformer(inputCol="features", outputCol="preds",
+                         modelFunction=mf, batchSize=4).transform(df)
+    got = np.array([r["preds"] for r in out.collect()], dtype=np.float32)
+    np.testing.assert_allclose(got, x @ w + b, rtol=1e-5, atol=1e-5)
+
+
+def test_tensor_transformer_scalar_column():
+    def apply_fn(_vs, x):
+        return x * 2.0
+
+    mf = ModelFunction.fromFunction(apply_fn, None, TensorSpec((None,)))
+    df = DataFrame.fromColumns({"v": np.arange(5, dtype=np.float32)})
+    out = TPUTransformer(inputCol="v", outputCol="o", modelFunction=mf,
+                         batchSize=2).transform(df).collect()
+    assert [r["o"] for r in out] == [[0.0], [2.0], [4.0], [6.0], [8.0]]
+
+
+def test_tensor_transformer_row_length_mismatch_raises():
+    mf, _, _ = _linear_model(in_dim=6)
+    x = np.zeros((4, 5), dtype=np.float32)
+    df = DataFrame.fromColumns({"features": x})
+    t = TPUTransformer(inputCol="features", outputCol="o", modelFunction=mf)
+    from sparkdl_tpu.engine.dataframe import TaskFailure
+    with pytest.raises(TaskFailure, match="elements"):
+        t.transform(df).collect()
+
+
+def test_image_transformer_vector_mode_matches_oracle(image_df):
+    df, arrays = image_df
+    mf = _image_model()
+    t = TPUImageTransformer(inputCol="image", outputCol="feat",
+                            modelFunction=mf, batchSize=4)
+    got = np.array([r["feat"] for r in t.transform(df).collect()],
+                   dtype=np.float32)
+    want = np.stack([a.astype(np.float32).mean(axis=(0, 1)) for a in arrays])
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-3)
+
+
+def test_image_transformer_resizes_to_model_input(rng):
+    # 16x12 inputs, model wants 8x8: host resize must kick in
+    arr = rng.integers(0, 255, size=(16, 12, 3), dtype=np.uint8)
+    struct = imageIO.imageArrayToStruct(arr)
+    df = DataFrame.fromRows([{"image": struct}],
+                            schema=pa.schema([pa.field("image", imageIO.imageSchema)]))
+    mf = _image_model(8, 8, 3)
+    out = TPUImageTransformer(inputCol="image", outputCol="feat",
+                              modelFunction=mf).transform(df).collect()
+    resized = imageIO.resizeImageArray(arr, (8, 8)).astype(np.float32)
+    np.testing.assert_allclose(np.array(out[0]["feat"]),
+                               resized.mean(axis=(0, 1)), rtol=1e-4, atol=1e-2)
+
+
+def test_image_transformer_null_rows_propagate(image_df):
+    df, arrays = image_df
+    rows = df.collect() + [{"image": None}]
+    schema = pa.schema([pa.field("image", imageIO.imageSchema)])
+    df2 = DataFrame.fromRows(rows, schema=schema, numPartitions=2)
+    mf = _image_model()
+    out = TPUImageTransformer(inputCol="image", outputCol="feat",
+                              modelFunction=mf).transform(df2).collect()
+    assert out[-1]["feat"] is None
+    assert all(r["feat"] is not None for r in out[:-1])
+
+
+def test_image_transformer_image_output_mode(image_df):
+    df, arrays = image_df
+
+    def apply_fn(_vs, x):
+        return x + 1.0
+
+    mf = ModelFunction.fromFunction(apply_fn, None, TensorSpec((None, 8, 8, 3)))
+    t = TPUImageTransformer(inputCol="image", outputCol="out",
+                            modelFunction=mf, outputMode="image")
+    out = t.transform(df).collect()
+    got = imageIO.imageStructToArray(out[0]["out"])
+    np.testing.assert_allclose(got, arrays[0].astype(np.float32) + 1.0,
+                               rtol=1e-5)
+    assert out[0]["out"]["origin"] == "img0"
+
+
+def test_image_transformer_rejects_bad_output_mode():
+    with pytest.raises(TypeError, match="outputMode"):
+        TPUImageTransformer(inputCol="a", outputCol="b", outputMode="nope")
+    t = TPUImageTransformer(inputCol="a", outputCol="b")
+    with pytest.raises(TypeError, match="outputMode"):
+        t.setOutputMode("tensor")  # setter path must validate too
+
+
+def test_missing_input_col_fails_fast():
+    df = DataFrame.fromColumns({"a": np.zeros((3, 6), dtype=np.float32)})
+    mf, _, _ = _linear_model()
+    with pytest.raises(KeyError, match="nope"):
+        TPUTransformer(inputCol="nope", outputCol="o",
+                       modelFunction=mf).transform(df)
+    with pytest.raises(KeyError, match="nope"):
+        TPUImageTransformer(inputCol="nope", outputCol="o",
+                            modelFunction=_image_model()).transform(df)
+
+
+def test_all_null_image_partition_yields_nulls():
+    schema = pa.schema([pa.field("image", imageIO.imageSchema)])
+    df = DataFrame.fromRows([{"image": None}, {"image": None}], schema=schema,
+                            numPartitions=1)
+    out = TPUImageTransformer(inputCol="image", outputCol="feat",
+                              modelFunction=_image_model()).transform(df).collect()
+    assert [r["feat"] for r in out] == [None, None]
+
+
+def test_tensor_transformer_empty_partition():
+    x = np.arange(12, dtype=np.float32).reshape(2, 6)
+    df = DataFrame.fromColumns({"features": x, "keep": [False, True]},
+                               numPartitions=2)
+    df = df.filter(lambda k: k, inputCols=["keep"])
+    mf, w, b = _linear_model()
+    out = TPUTransformer(inputCol="features", outputCol="o",
+                         modelFunction=mf).transform(df).collect()
+    assert len(out) == 1
+    np.testing.assert_allclose(np.array(out[0]["o"], dtype=np.float32),
+                               x[1] @ w + b, rtol=1e-5)
+
+
+def test_reference_alias_names():
+    assert TFImageTransformer is TPUImageTransformer
+    assert TFTransformer is TPUTransformer
