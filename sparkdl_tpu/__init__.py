@@ -23,6 +23,25 @@ _LAZY_EXPORTS = {
     "readImagesWithCustomFn": ("sparkdl_tpu.image", "readImagesWithCustomFn"),
     # engine
     "DataFrame": ("sparkdl_tpu.engine", "DataFrame"),
+    # ml pipeline surface (reference __all__ parity)
+    "Pipeline": ("sparkdl_tpu.ml", "Pipeline"),
+    "PipelineModel": ("sparkdl_tpu.ml", "PipelineModel"),
+    "Transformer": ("sparkdl_tpu.ml", "Transformer"),
+    "Estimator": ("sparkdl_tpu.ml", "Estimator"),
+    "TFImageTransformer": ("sparkdl_tpu.ml", "TFImageTransformer"),
+    "TFTransformer": ("sparkdl_tpu.ml", "TFTransformer"),
+    "TPUImageTransformer": ("sparkdl_tpu.ml", "TPUImageTransformer"),
+    "TPUTransformer": ("sparkdl_tpu.ml", "TPUTransformer"),
+    "DeepImageFeaturizer": ("sparkdl_tpu.ml", "DeepImageFeaturizer"),
+    "DeepImagePredictor": ("sparkdl_tpu.ml", "DeepImagePredictor"),
+    "KerasImageFileTransformer": ("sparkdl_tpu.ml", "KerasImageFileTransformer"),
+    "KerasTransformer": ("sparkdl_tpu.ml", "KerasTransformer"),
+    # udf serving surface
+    "registerKerasImageUDF": ("sparkdl_tpu.udf", "registerKerasImageUDF"),
+    "registerImageUDF": ("sparkdl_tpu.udf", "registerImageUDF"),
+    "registerTensorUDF": ("sparkdl_tpu.udf", "registerTensorUDF"),
+    "registerUDF": ("sparkdl_tpu.udf", "registerUDF"),
+    "udf_registry": ("sparkdl_tpu.udf", "udf_registry"),
 }
 
 __all__ = ["__version__"] + sorted(_LAZY_EXPORTS)

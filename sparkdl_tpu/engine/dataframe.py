@@ -23,7 +23,7 @@ from __future__ import annotations
 import concurrent.futures as _futures
 import os
 import threading
-from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
 import pandas as pd
@@ -233,6 +233,53 @@ class DataFrame:
     def drop(self, *cols: str) -> "DataFrame":
         keep = [c for c in self.columns if c not in cols]
         return self.select(*keep)
+
+    def selectExpr(self, *exprs: str) -> "DataFrame":
+        """SQL-lite projection: ``"col"``, ``"col as alias"``, or
+        ``"udf_name(col) [as alias]"`` invoking a registered UDF.
+
+        The engine analog of the reference's model-as-SQL-UDF serving path
+        (``spark.sql("SELECT my_udf(image) FROM ...")``, SURVEY.md §3.4).
+        UDFs resolve against ``sparkdl_tpu.udf.udf_registry``.
+        """
+        import re
+
+        pattern = re.compile(
+            r"^\s*(?:(?P<fn>\w+)\s*\(\s*(?P<arg>\w+)\s*\)|(?P<col>\w+))"
+            r"(?:\s+[aA][sS]\s+(?P<alias>\w+))?\s*$")
+        frame = self
+        # (source_col_on_frame, output_name); rename happens only in the
+        # final projection so one source column can feed several outputs.
+        projection: List[Tuple[str, str]] = []
+        for expr in exprs:
+            m = pattern.match(expr)
+            if not m:
+                raise ValueError(f"Cannot parse expression {expr!r}")
+            if m.group("fn"):
+                from sparkdl_tpu.udf import udf_registry  # lazy: layering
+
+                name, arg = m.group("fn"), m.group("arg")
+                alias = m.group("alias") or f"{name}({arg})"
+                frame = udf_registry.get(name).apply(frame, arg, alias)
+                projection.append((alias, alias))
+            else:
+                col = m.group("col")
+                if col not in self.columns:
+                    raise KeyError(f"No such column: {col!r}")
+                projection.append((col, m.group("alias") or col))
+
+        def project(batch: pa.RecordBatch) -> pa.RecordBatch:
+            cols = [batch.column(batch.schema.get_field_index(src))
+                    for src, _ in projection]
+            actual = pa.schema([pa.field(out, c.type)
+                                for (_, out), c in zip(projection, cols)])
+            return pa.RecordBatch.from_arrays(cols, schema=actual)
+
+        schema = pa.schema([
+            pa.field(out, frame._schema.field(src).type
+                     if src in frame._schema.names else pa.null())
+            for src, out in projection])
+        return frame._with_op(project, schema)
 
     def withColumnRenamed(self, existing: str, new: str) -> "DataFrame":
         if existing not in self.columns:

@@ -16,6 +16,9 @@ from sparkdl_tpu.ml.base import (
     Transformer,
 )
 from sparkdl_tpu.ml.image_transformer import TPUImageTransformer
+from sparkdl_tpu.ml.keras_image import KerasImageFileTransformer
+from sparkdl_tpu.ml.keras_tensor import KerasTransformer
+from sparkdl_tpu.ml.named_image import DeepImageFeaturizer, DeepImagePredictor
 from sparkdl_tpu.ml.tensor_transformer import TPUTransformer
 
 # Reference-compatible aliases: the reference's names execute TF graphs;
@@ -24,7 +27,11 @@ TFImageTransformer = TPUImageTransformer
 TFTransformer = TPUTransformer
 
 __all__ = [
+    "DeepImageFeaturizer",
+    "DeepImagePredictor",
     "Estimator",
+    "KerasImageFileTransformer",
+    "KerasTransformer",
     "Model",
     "Pipeline",
     "PipelineModel",

@@ -1,0 +1,95 @@
+"""KerasImageFileTransformer — image URIs → Keras model → predictions.
+
+Parity: the reference's ``transformers/keras_image.py`` (SURVEY.md §2.1):
+mixes in ``CanLoadImage`` (URI → decode → user preprocessor → image
+struct), converts the Keras model and runs it through the image
+transformer. Here the Keras model is ingested once by the generic layer-DAG
+walker (models.keras_ingest) into a jitted XLA program.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from sparkdl_tpu.ml.base import Transformer
+from sparkdl_tpu.ml.image_transformer import TPUImageTransformer
+from sparkdl_tpu.param.base import keyword_only
+from sparkdl_tpu.param.shared_params import (
+    CanLoadImage,
+    HasBatchSize,
+    HasInputCol,
+    HasKerasModel,
+    HasOutputCol,
+    HasOutputMode,
+)
+
+_LOADED_IMAGE_COL = "__sdl_loaded_image"
+
+
+class KerasImageFileTransformer(Transformer, HasInputCol, HasOutputCol,
+                                HasKerasModel, CanLoadImage, HasOutputMode,
+                                HasBatchSize):
+    """Apply a Keras model (from file or object) to an image-URI column."""
+
+    @keyword_only
+    def __init__(self, *, inputCol: Optional[str] = None,
+                 outputCol: Optional[str] = None,
+                 modelFile: Optional[str] = None,
+                 model=None,
+                 imageLoader: Optional[Callable] = None,
+                 outputMode: str = "vector",
+                 batchSize: int = 64) -> None:
+        super().__init__()
+        self._setDefault(outputMode="vector", batchSize=64)
+        self._mf_cache = None
+        kwargs = self._input_kwargs
+        self.setParams(**kwargs)
+
+    @keyword_only
+    def setParams(self, *, inputCol: Optional[str] = None,
+                  outputCol: Optional[str] = None,
+                  modelFile: Optional[str] = None,
+                  model=None,
+                  imageLoader: Optional[Callable] = None,
+                  outputMode: str = "vector",
+                  batchSize: int = 64) -> "KerasImageFileTransformer":
+        kwargs = dict(self._input_kwargs)
+        loader = kwargs.pop("imageLoader", None)
+        if {"model", "modelFile"} & kwargs.keys():
+            self._mf_cache = None
+        self._set(**kwargs)
+        if loader is not None:
+            self.setImageLoader(loader)
+        return self
+
+    def _model_function(self):
+        if self._mf_cache is None:
+            self._mf_cache = self.loadKerasModelAsFunction()
+        return self._mf_cache
+
+    def copy(self, extra=None):
+        that = super().copy(extra)
+        that._mf_cache = None
+        return that
+
+    def setModel(self, value):
+        self._mf_cache = None
+        return super().setModel(value)
+
+    def setModelFile(self, value):
+        self._mf_cache = None
+        return super().setModelFile(value)
+
+    def _transform(self, dataset):
+        mf = self._model_function()
+        shape = mf.input_spec.shape
+        target_size = ((shape[1], shape[2])
+                       if len(shape) == 4 and None not in shape[1:3] else None)
+        loaded = self.loadImagesInternal(
+            dataset, self.getInputCol(), _LOADED_IMAGE_COL,
+            target_size=target_size)
+        inner = TPUImageTransformer(
+            inputCol=_LOADED_IMAGE_COL, outputCol=self.getOutputCol(),
+            modelFunction=mf, outputMode=self.getOutputMode(),
+            batchSize=self.getBatchSize())
+        return inner.transform(loaded).drop(_LOADED_IMAGE_COL)

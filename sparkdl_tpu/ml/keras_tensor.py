@@ -1,0 +1,68 @@
+"""KerasTransformer — 1-D array column → Keras model → output arrays.
+
+Parity: the reference's ``transformers/keras_tensor.py`` (SURVEY.md §2.1):
+loads a Keras model, converts it to a graph, executes via ``TFTransformer``.
+Here: generic layer-DAG ingestion (models.keras_ingest) → TPUTransformer.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from sparkdl_tpu.ml.base import Transformer
+from sparkdl_tpu.ml.tensor_transformer import TPUTransformer
+from sparkdl_tpu.param.base import keyword_only
+from sparkdl_tpu.param.shared_params import (
+    HasBatchSize,
+    HasInputCol,
+    HasKerasModel,
+    HasOutputCol,
+)
+
+
+class KerasTransformer(Transformer, HasInputCol, HasOutputCol,
+                       HasKerasModel, HasBatchSize):
+    """Apply a Keras model to a numeric column (1-D rows)."""
+
+    @keyword_only
+    def __init__(self, *, inputCol: Optional[str] = None,
+                 outputCol: Optional[str] = None,
+                 modelFile: Optional[str] = None,
+                 model=None,
+                 batchSize: int = 64) -> None:
+        super().__init__()
+        self._setDefault(batchSize=64)
+        self._mf_cache = None
+        kwargs = self._input_kwargs
+        self.setParams(**kwargs)
+
+    @keyword_only
+    def setParams(self, *, inputCol: Optional[str] = None,
+                  outputCol: Optional[str] = None,
+                  modelFile: Optional[str] = None,
+                  model=None,
+                  batchSize: int = 64) -> "KerasTransformer":
+        if {"model", "modelFile"} & self._input_kwargs.keys():
+            self._mf_cache = None
+        return self._set(**self._input_kwargs)
+
+    def copy(self, extra=None):
+        that = super().copy(extra)
+        that._mf_cache = None
+        return that
+
+    def setModel(self, value):
+        self._mf_cache = None
+        return super().setModel(value)
+
+    def setModelFile(self, value):
+        self._mf_cache = None
+        return super().setModelFile(value)
+
+    def _transform(self, dataset):
+        if self._mf_cache is None:
+            self._mf_cache = self.loadKerasModelAsFunction()
+        inner = TPUTransformer(
+            inputCol=self.getInputCol(), outputCol=self.getOutputCol(),
+            modelFunction=self._mf_cache, batchSize=self.getBatchSize())
+        return inner.transform(dataset)

@@ -1,0 +1,218 @@
+"""DeepImagePredictor / DeepImageFeaturizer — pre-trained named models.
+
+Parity: the reference's ``transformers/named_image.py`` (SURVEY.md §2.1,
+§3.1 — the flagship path). There ``DeepImageFeaturizer`` delegated to a
+Scala JavaTransformer that ran a frozen graph-def through TensorFrames;
+here the named model is a Flax module from the in-repo zoo, weights
+resident in HBM, and featurize/predict are one jitted XLA program
+(device-side preprocess fused in front, SURVEY.md §7).
+
+``DeepImagePredictor(decodePredictions=True)`` emits top-K
+``(class, description, probability)`` rows like the reference's
+keras ``decode_predictions``; class names come from a local ImageNet
+index if one is available (keras cache), else stable ``class_<i>`` ids —
+no network access is assumed anywhere.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+import numpy as np
+import pyarrow as pa
+
+from sparkdl_tpu.ml.base import Transformer
+from sparkdl_tpu.ml.image_transformer import TPUImageTransformer
+from sparkdl_tpu.models import registry
+from sparkdl_tpu.param.base import Param, keyword_only
+from sparkdl_tpu.param.converters import SparkDLTypeConverters, TypeConverters
+from sparkdl_tpu.param.shared_params import (
+    HasBatchSize,
+    HasInputCol,
+    HasOutputCol,
+)
+
+SUPPORTED_MODELS = registry.SUPPORTED_MODEL_NAMES
+
+
+class _NamedImageTransformer(Transformer, HasInputCol, HasOutputCol,
+                             HasBatchSize):
+    """Shared plumbing: modelName param + cached ModelFunction build."""
+
+    modelName = Param(
+        "_NamedImageTransformer", "modelName",
+        f"name of the pre-trained model, one of {SUPPORTED_MODELS}",
+        typeConverter=SparkDLTypeConverters.supportedNameConverter(
+            SUPPORTED_MODELS))
+    weights = Param(
+        "_NamedImageTransformer", "weights",
+        "weight source: 'random' (seeded init), a Flax variables dict, a "
+        "Keras model/.h5/.keras file, a msgpack file, or an Orbax dir",
+        typeConverter=TypeConverters.identity)
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._setDefault(batchSize=64, weights="random")
+        self._mf_cache = {}
+
+    def setModelName(self, value: str):
+        return self._set(modelName=value)
+
+    def getModelName(self) -> str:
+        return self.getOrDefault(self.modelName)
+
+    def setWeights(self, value):
+        return self._set(weights=value)
+
+    def getWeights(self):
+        return self.getOrDefault(self.weights)
+
+    def _model_function(self, kind: str):
+        name = self.getModelName()
+        weights = self.getWeights()
+        # Cache keyed by (kind, name) and validated against the exact weights
+        # object/path — bounded size, and a new weights value (even one
+        # reusing a freed object's address) can never hit a stale entry.
+        key = (kind, name)
+        cached = self._mf_cache.get(key)
+        if cached is not None:
+            cached_weights, mf = cached
+            if cached_weights is weights or (
+                    isinstance(weights, str) and cached_weights == weights):
+                return mf
+        build = (registry.build_featurizer if kind == "featurize"
+                 else registry.build_predictor)
+        mf = build(name, weights=weights)
+        self._mf_cache[key] = (weights, mf)
+        return mf
+
+    def copy(self, extra=None):
+        that = super().copy(extra)
+        that._mf_cache = {}
+        return that
+
+
+class DeepImageFeaturizer(_NamedImageTransformer):
+    """Headless named CNN → feature-vector column (transfer learning).
+
+    The features feed a downstream cheap learner (e.g. LogisticRegression)
+    in a Pipeline — the reference's headline use case.
+    """
+
+    @keyword_only
+    def __init__(self, *, inputCol: Optional[str] = None,
+                 outputCol: Optional[str] = None,
+                 modelName: Optional[str] = None,
+                 weights="random",
+                 batchSize: int = 64) -> None:
+        super().__init__()
+        kwargs = self._input_kwargs
+        self.setParams(**kwargs)
+
+    @keyword_only
+    def setParams(self, *, inputCol: Optional[str] = None,
+                  outputCol: Optional[str] = None,
+                  modelName: Optional[str] = None,
+                  weights="random",
+                  batchSize: int = 64) -> "DeepImageFeaturizer":
+        return self._set(**self._input_kwargs)
+
+    def _transform(self, dataset):
+        mf = self._model_function("featurize")
+        inner = TPUImageTransformer(
+            inputCol=self.getInputCol(), outputCol=self.getOutputCol(),
+            modelFunction=mf, outputMode="vector",
+            batchSize=self.getBatchSize())
+        return inner.transform(dataset)
+
+
+class DeepImagePredictor(_NamedImageTransformer):
+    """Full named CNN → class-probability column, optionally decoded top-K."""
+
+    decodePredictions = Param(
+        "DeepImagePredictor", "decodePredictions",
+        "when true, output a list of top-K (class, description, probability) "
+        "structs instead of the raw probability vector",
+        typeConverter=TypeConverters.toBoolean)
+    topK = Param("DeepImagePredictor", "topK",
+                 "how many top classes to keep when decoding",
+                 typeConverter=TypeConverters.toInt)
+
+    @keyword_only
+    def __init__(self, *, inputCol: Optional[str] = None,
+                 outputCol: Optional[str] = None,
+                 modelName: Optional[str] = None,
+                 weights="random",
+                 decodePredictions: bool = False,
+                 topK: int = 5,
+                 batchSize: int = 64) -> None:
+        super().__init__()
+        self._setDefault(decodePredictions=False, topK=5)
+        kwargs = self._input_kwargs
+        self.setParams(**kwargs)
+
+    @keyword_only
+    def setParams(self, *, inputCol: Optional[str] = None,
+                  outputCol: Optional[str] = None,
+                  modelName: Optional[str] = None,
+                  weights="random",
+                  decodePredictions: bool = False,
+                  topK: int = 5,
+                  batchSize: int = 64) -> "DeepImagePredictor":
+        return self._set(**self._input_kwargs)
+
+    def _transform(self, dataset):
+        mf = self._model_function("predict")
+        out_col = self.getOutputCol()
+        decode = self.getOrDefault(self.decodePredictions)
+        raw_col = out_col if not decode else out_col + "__raw"
+        inner = TPUImageTransformer(
+            inputCol=self.getInputCol(), outputCol=raw_col,
+            modelFunction=mf, outputMode="vector",
+            batchSize=self.getBatchSize())
+        frame = inner.transform(dataset)
+        if not decode:
+            return frame
+        k = self.getOrDefault(self.topK)
+        labels = imagenet_labels(
+            registry.get_model_spec(self.getModelName()).classes)
+        decoded_type = pa.list_(pa.struct([
+            pa.field("class", pa.string()),
+            pa.field("description", pa.string()),
+            pa.field("probability", pa.float32())]))
+
+        def decode_row(probs):
+            if probs is None:
+                return None
+            p = np.asarray(probs, dtype=np.float32)
+            top = np.argsort(-p)[:k]
+            return [{"class": labels[i][0], "description": labels[i][1],
+                     "probability": float(p[i])} for i in top]
+
+        frame = frame.withColumn(out_col, decode_row, inputCols=[raw_col],
+                                 outputType=decoded_type)
+        return frame.drop(raw_col)
+
+
+def imagenet_labels(n_classes: int = 1000):
+    """[(wnid, human_name)] — local keras cache if present, else stable ids.
+
+    The reference relied on keras's ``decode_predictions`` which downloads
+    ``imagenet_class_index.json``; this environment has no egress, so a
+    cached copy is used when found and a deterministic fallback otherwise.
+    """
+    candidates = [
+        os.path.expanduser("~/.keras/models/imagenet_class_index.json"),
+        os.path.expanduser("~/.keras/imagenet_class_index.json"),
+    ]
+    for path in candidates:
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    index = json.load(f)
+                return [tuple(index[str(i)]) for i in range(n_classes)]
+            except (OSError, KeyError, json.JSONDecodeError):
+                break
+    return [(f"class_{i}", f"class_{i}") for i in range(n_classes)]

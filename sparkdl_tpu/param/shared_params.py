@@ -162,3 +162,84 @@ class CanLoadImage(Params):
         return dataframe.withColumn(
             outputCol, load_one, inputCols=[inputCol],
             outputType=imageIO.imageSchema)
+
+
+class HasKerasModel(Params):
+    """Mixin: a Keras model supplied as a file path or in-memory object.
+
+    Parity: upstream ``HasKerasModel`` carried an HDF5 ``modelFile``; here
+    ``.h5``/``.keras`` files load through keras and are ingested by the
+    generic layer-DAG walker into a jitted ModelFunction
+    (models.keras_ingest), so any supported Keras model runs on TPU.
+    """
+
+    modelFile = Param(
+        "HasKerasModel", "modelFile",
+        "path to a saved Keras model (.h5 or .keras)",
+        typeConverter=TypeConverters.toString)
+    model = Param(
+        "HasKerasModel", "model",
+        "in-memory Keras model object (alternative to modelFile)",
+        typeConverter=TypeConverters.identity)
+
+    def setModelFile(self, value: str) -> "HasKerasModel":
+        return self._set(modelFile=value)
+
+    def getModelFile(self) -> Optional[str]:
+        return self.getOrDefault(self.modelFile) if self.isDefined(self.modelFile) else None
+
+    def setModel(self, value: Any) -> "HasKerasModel":
+        return self._set(model=value)
+
+    def getModel(self) -> Any:
+        return self.getOrDefault(self.model) if self.isDefined(self.model) else None
+
+    def loadKerasModelAsFunction(self):
+        """Resolve model/modelFile to a ModelFunction (generic ingestion)."""
+        from sparkdl_tpu.models.convert import load_keras_file
+        from sparkdl_tpu.models.keras_ingest import keras_to_model_function
+
+        model = self.getModel()
+        if model is None:
+            path = self.getModelFile()
+            if path is None:
+                raise ValueError("set either model or modelFile")
+            model = load_keras_file(path)
+        return keras_to_model_function(model)
+
+
+class HasKerasOptimizer(Params):
+    """Parity: upstream ``HasKerasOptimizer`` (keras optimizer name).
+
+    The TPU estimator trains with optax; the accepted names map onto optax
+    constructors (estimators module) while keeping keras-style spelling.
+    """
+
+    kerasOptimizer = Param(
+        "HasKerasOptimizer", "kerasOptimizer",
+        "optimizer name: one of 'adam', 'sgd', 'rmsprop', 'adagrad', "
+        "'adamw'",
+        typeConverter=TypeConverters.toString)
+
+    def setKerasOptimizer(self, value: str) -> "HasKerasOptimizer":
+        return self._set(kerasOptimizer=value)
+
+    def getKerasOptimizer(self) -> str:
+        return self.getOrDefault(self.kerasOptimizer)
+
+
+class HasKerasLoss(Params):
+    """Parity: upstream ``HasKerasLoss`` (keras loss name)."""
+
+    kerasLoss = Param(
+        "HasKerasLoss", "kerasLoss",
+        "loss name: one of 'categorical_crossentropy', "
+        "'sparse_categorical_crossentropy', 'binary_crossentropy', 'mse', "
+        "'mae'",
+        typeConverter=TypeConverters.toString)
+
+    def setKerasLoss(self, value: str) -> "HasKerasLoss":
+        return self._set(kerasLoss=value)
+
+    def getKerasLoss(self) -> str:
+        return self.getOrDefault(self.kerasLoss)

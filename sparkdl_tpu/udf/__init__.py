@@ -1,0 +1,27 @@
+"""Model-as-UDF registry (SQL-serving parity layer).
+
+Parity: the reference's ``udf/keras_image_model.py`` +
+``graph/tensorframes_udf.py`` (SURVEY.md §2.1, §3.4): a Keras model became
+a named Spark SQL UDF executed by TensorFrames. Here a named UDF is a
+column operator on the engine's DataFrame — either a plain row function or
+a jitted ModelFunction applied batch-wise — invoked via
+``DataFrame.selectExpr("my_udf(image) as preds")``.
+"""
+
+from sparkdl_tpu.udf.registry import (
+    UDFRegistry,
+    registerImageUDF,
+    registerKerasImageUDF,
+    registerTensorUDF,
+    registerUDF,
+    udf_registry,
+)
+
+__all__ = [
+    "UDFRegistry",
+    "registerImageUDF",
+    "registerKerasImageUDF",
+    "registerTensorUDF",
+    "registerUDF",
+    "udf_registry",
+]

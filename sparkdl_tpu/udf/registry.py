@@ -1,0 +1,158 @@
+"""Named UDF registry + registration helpers.
+
+A registered UDF is an object with ``apply(df, input_col, output_col) ->
+df`` — uniform for plain row functions and device model UDFs, so the
+engine's ``selectExpr`` can invoke any of them by name (the reference's
+``spark.sql("SELECT my_udf(image) ...")`` analog, SURVEY.md §3.4).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+
+class ColumnUDF:
+    """A named column operator: ``apply(df, input_col, output_col)``."""
+
+    def __init__(self, name: str, apply_fn: Callable, kind: str) -> None:
+        self.name = name
+        self._apply_fn = apply_fn
+        self.kind = kind
+
+    def apply(self, df, input_col: str, output_col: str):
+        return self._apply_fn(df, input_col, output_col)
+
+    def __repr__(self) -> str:
+        return f"ColumnUDF({self.name!r}, kind={self.kind!r})"
+
+
+class UDFRegistry:
+    """Process-wide named UDFs (the SQL-function namespace analog)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._udfs: Dict[str, ColumnUDF] = {}
+
+    def register(self, udf: ColumnUDF, replace: bool = True) -> ColumnUDF:
+        with self._lock:
+            if not replace and udf.name in self._udfs:
+                raise ValueError(f"UDF {udf.name!r} already registered")
+            self._udfs[udf.name] = udf
+        return udf
+
+    def get(self, name: str) -> ColumnUDF:
+        with self._lock:
+            try:
+                return self._udfs[name]
+            except KeyError:
+                raise KeyError(
+                    f"No UDF named {name!r}; registered: "
+                    f"{sorted(self._udfs)}") from None
+
+    def contains(self, name: str) -> bool:
+        with self._lock:
+            return name in self._udfs
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._udfs.pop(name, None)
+
+    def names(self):
+        with self._lock:
+            return sorted(self._udfs)
+
+
+udf_registry = UDFRegistry()
+
+
+def registerUDF(name: str, fn: Callable, outputType=None,
+                registry: Optional[UDFRegistry] = None) -> ColumnUDF:
+    """Register a plain row function ``value -> value`` under ``name``."""
+
+    def apply_fn(df, input_col, output_col):
+        return df.withColumn(output_col, fn, inputCols=[input_col],
+                             outputType=outputType)
+
+    return (registry or udf_registry).register(ColumnUDF(name, apply_fn, "row"))
+
+
+def registerTensorUDF(name: str, modelFunction, batchSize: int = 64,
+                      registry: Optional[UDFRegistry] = None) -> ColumnUDF:
+    """Register a ModelFunction over numeric columns under ``name``."""
+
+    def apply_fn(df, input_col, output_col):
+        from sparkdl_tpu.ml.tensor_transformer import TPUTransformer
+
+        return TPUTransformer(inputCol=input_col, outputCol=output_col,
+                              modelFunction=modelFunction,
+                              batchSize=batchSize).transform(df)
+
+    return (registry or udf_registry).register(
+        ColumnUDF(name, apply_fn, "tensor_model"))
+
+
+def registerImageUDF(name: str, modelFunction, batchSize: int = 64,
+                     preprocessor: Optional[Callable] = None,
+                     registry: Optional[UDFRegistry] = None) -> ColumnUDF:
+    """Register a ModelFunction over image-struct columns under ``name``.
+
+    ``preprocessor`` (optional): host-side ``HWC ndarray -> HWC ndarray``
+    applied per image before staging — the analog of the reference's
+    preprocessor graph piece composed in front of the model (§3.4).
+    """
+
+    def apply_fn(df, input_col, output_col):
+        from sparkdl_tpu.image import imageIO
+        from sparkdl_tpu.ml.image_transformer import TPUImageTransformer
+
+        frame = df
+        model_input = input_col
+        if preprocessor is not None:
+            tmp = output_col + "__pre"
+
+            def pre(struct):
+                if struct is None:
+                    return None
+                arr = preprocessor(imageIO.imageStructToArray(struct))
+                return imageIO.imageArrayToStruct(
+                    np.asarray(arr), origin=struct.get("origin", ""))
+
+            frame = df.withColumn(tmp, pre, inputCols=[input_col],
+                                  outputType=imageIO.imageSchema)
+            model_input = tmp
+        out = TPUImageTransformer(
+            inputCol=model_input, outputCol=output_col,
+            modelFunction=modelFunction, outputMode="vector",
+            batchSize=batchSize).transform(frame)
+        if model_input != input_col:
+            out = out.drop(model_input)
+        return out
+
+    return (registry or udf_registry).register(
+        ColumnUDF(name, apply_fn, "image_model"))
+
+
+def registerKerasImageUDF(udfName: str, kerasModelOrFile: Any,
+                          preprocessor: Optional[Callable] = None,
+                          batchSize: int = 64,
+                          registry: Optional[UDFRegistry] = None) -> ColumnUDF:
+    """Keras model (object or .h5/.keras path) as a named image UDF.
+
+    Parity: ``sparkdl.udf.keras_image_model.registerKerasImageUDF``. The
+    model is ingested once by the generic layer-DAG walker and served as a
+    jitted XLA program.
+    """
+    from sparkdl_tpu.models.keras_ingest import keras_to_model_function
+
+    if isinstance(kerasModelOrFile, str):
+        from sparkdl_tpu.models.convert import load_keras_file
+
+        keras_model = load_keras_file(kerasModelOrFile)
+    else:
+        keras_model = kerasModelOrFile
+    mf = keras_to_model_function(keras_model, name=udfName)
+    return registerImageUDF(udfName, mf, batchSize=batchSize,
+                            preprocessor=preprocessor, registry=registry)

@@ -1,0 +1,107 @@
+"""KerasTransformer / KerasImageFileTransformer oracle tests.
+
+The reference asserted pipeline output == plain keras predict on the same
+inputs (SURVEY.md §4 oracle pattern); reproduced here end-to-end through
+the engine, including the .h5/.keras file path.
+"""
+
+import numpy as np
+import pytest
+
+keras = pytest.importorskip("keras")
+from keras import layers  # noqa: E402
+
+from sparkdl_tpu.engine.dataframe import DataFrame  # noqa: E402
+from sparkdl_tpu.image import imageIO  # noqa: E402
+from sparkdl_tpu.ml import KerasImageFileTransformer, KerasTransformer  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def dense_model():
+    m = keras.Sequential([keras.Input((6,)),
+                          layers.Dense(10, activation="relu"),
+                          layers.Dense(3)])
+    return m
+
+
+def test_keras_transformer_matches_predict(dense_model, rng):
+    x = rng.normal(size=(9, 6)).astype(np.float32)
+    df = DataFrame.fromColumns({"features": x}, numPartitions=3)
+    t = KerasTransformer(inputCol="features", outputCol="out",
+                         model=dense_model, batchSize=4)
+    got = np.array([r["out"] for r in t.transform(df).collect()],
+                   dtype=np.float32)
+    want = dense_model.predict(x, verbose=0)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_keras_transformer_from_file(dense_model, rng, tmp_path):
+    path = str(tmp_path / "model.keras")
+    dense_model.save(path)
+    x = rng.normal(size=(4, 6)).astype(np.float32)
+    df = DataFrame.fromColumns({"features": x})
+    t = KerasTransformer(inputCol="features", outputCol="out", modelFile=path)
+    got = np.array([r["out"] for r in t.transform(df).collect()],
+                   dtype=np.float32)
+    np.testing.assert_allclose(got, dense_model.predict(x, verbose=0),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_keras_transformer_requires_model():
+    t = KerasTransformer(inputCol="a", outputCol="b")
+    df = DataFrame.fromColumns({"a": np.zeros((2, 6), dtype=np.float32)})
+    with pytest.raises(ValueError, match="model"):
+        t.transform(df)
+
+
+def test_keras_transformer_set_model_invalidates_cache(rng):
+    m1 = keras.Sequential([keras.Input((3,)), layers.Dense(1,
+                           kernel_initializer="ones", use_bias=False)])
+    m2 = keras.Sequential([keras.Input((3,)), layers.Dense(1,
+                           kernel_initializer="zeros", use_bias=False)])
+    df = DataFrame.fromColumns({"v": np.ones((2, 3), dtype=np.float32)})
+    t = KerasTransformer(inputCol="v", outputCol="o", model=m1)
+    assert t.transform(df).collect()[0]["o"] == [3.0]
+    t.setModel(m2)
+    assert t.transform(df).collect()[0]["o"] == [0.0]
+    t.setParams(model=m1)
+    assert t.transform(df).collect()[0]["o"] == [3.0]
+
+
+def test_keras_image_file_transformer_end_to_end(tiny_image_dir, rng):
+    # tiny CNN over 16x16 inputs
+    m = keras.Sequential([keras.Input((16, 16, 3)),
+                          layers.Conv2D(4, 3, activation="relu"),
+                          layers.GlobalAveragePooling2D(),
+                          layers.Dense(2, activation="softmax")])
+    files = [str(p) for p in sorted(tiny_image_dir.glob("*.jpg"))]
+    df = DataFrame.fromRows([{"uri": f} for f in files], numPartitions=2)
+    t = KerasImageFileTransformer(inputCol="uri", outputCol="preds",
+                                  model=m, batchSize=2)
+    out = t.transform(df).collect()
+    got = np.array([r["preds"] for r in out], dtype=np.float32)
+    # oracle: decode+resize the same way, then keras predict
+    batch = np.stack([
+        imageIO.decodeImageFile(f, target_size=(16, 16)).astype(np.float32)
+        for f in files])
+    want = m.predict(batch, verbose=0)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+    # the temp loaded-image column must not leak into the output
+    assert set(t.transform(df).columns) == {"uri", "preds"}
+
+
+def test_keras_image_file_transformer_custom_loader(tiny_image_dir):
+    m = keras.Sequential([keras.Input((8, 8, 3)),
+                          layers.Flatten(), layers.Dense(2)])
+    files = [str(p) for p in sorted(tiny_image_dir.glob("*.jpg"))][:2]
+    df = DataFrame.fromRows([{"uri": f} for f in files])
+
+    def loader(uri):
+        # constant image: output must be identical across rows
+        return np.full((8, 8, 3), 7, dtype=np.uint8)
+
+    t = KerasImageFileTransformer(inputCol="uri", outputCol="preds",
+                                  model=m, imageLoader=loader)
+    out = t.transform(df).collect()
+    a, b = (np.array(r["preds"], dtype=np.float32) for r in out)
+    np.testing.assert_array_equal(a, b)

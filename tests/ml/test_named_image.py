@@ -1,0 +1,92 @@
+"""DeepImageFeaturizer / DeepImagePredictor tests.
+
+Uses TestNet (tiny deterministic model, SURVEY.md §2.2 Models.scala parity)
+so tests don't need pretrained weights, exactly like the reference's Scala
+suite did.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from sparkdl_tpu.engine.dataframe import DataFrame
+from sparkdl_tpu.image import imageIO
+from sparkdl_tpu.ml import DeepImageFeaturizer, DeepImagePredictor
+from sparkdl_tpu.models import registry
+
+
+@pytest.fixture
+def image_df(rng):
+    rows = []
+    for i in range(5):
+        arr = rng.integers(0, 255, size=(40, 36, 3), dtype=np.uint8)
+        rows.append({"image": imageIO.imageArrayToStruct(arr, origin=f"i{i}")})
+    return DataFrame.fromRows(
+        rows, schema=pa.schema([pa.field("image", imageIO.imageSchema)]),
+        numPartitions=2)
+
+
+def test_featurizer_output_dim_and_determinism(image_df):
+    f = DeepImageFeaturizer(inputCol="image", outputCol="features",
+                            modelName="TestNet", batchSize=4)
+    out1 = f.transform(image_df).collect()
+    out2 = f.transform(image_df).collect()
+    spec = registry.get_model_spec("TestNet")
+    assert len(out1[0]["features"]) == spec.feature_dim
+    np.testing.assert_array_equal(
+        np.array([r["features"] for r in out1]),
+        np.array([r["features"] for r in out2]))
+
+
+def test_featurizer_matches_direct_model_function(image_df):
+    # oracle: the same registry ModelFunction applied by hand
+    f = DeepImageFeaturizer(inputCol="image", outputCol="features",
+                            modelName="TestNet")
+    got = np.array([r["features"]
+                    for r in f.transform(image_df).collect()], dtype=np.float32)
+    mf = registry.build_featurizer("TestNet")
+    spec = registry.get_model_spec("TestNet")
+    structs = [r["image"] for r in image_df.collect()]
+    batch = imageIO.imageStructsToBatchArray(structs,
+                                             target_size=spec.input_size)
+    want = np.asarray(mf.apply_batch(batch, batch_size=8)).reshape(len(structs), -1)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_predictor_probabilities_sum_to_one(image_df):
+    p = DeepImagePredictor(inputCol="image", outputCol="preds",
+                           modelName="TestNet")
+    out = p.transform(image_df).collect()
+    probs = np.array([r["preds"] for r in out], dtype=np.float32)
+    spec = registry.get_model_spec("TestNet")
+    assert probs.shape == (5, spec.classes)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-4)
+
+
+def test_predictor_decode_topk(image_df):
+    p = DeepImagePredictor(inputCol="image", outputCol="preds",
+                           modelName="TestNet", decodePredictions=True,
+                           topK=3)
+    out = p.transform(image_df).collect()
+    row = out[0]["preds"]
+    assert len(row) == 3
+    # descending probability, fields present
+    probs = [e["probability"] for e in row]
+    assert probs == sorted(probs, reverse=True)
+    assert all(e["class"] and e["description"] is not None for e in row)
+    # raw column dropped
+    assert "preds__raw" not in out[0]
+
+
+def test_unknown_model_name_rejected():
+    with pytest.raises(TypeError, match="supported list"):
+        DeepImageFeaturizer(inputCol="image", outputCol="f",
+                            modelName="NotAModel")
+
+
+def test_featurizer_param_copy_isolated(image_df):
+    f = DeepImageFeaturizer(inputCol="image", outputCol="features",
+                            modelName="TestNet")
+    g = f.copy({f.batchSize: 2})
+    assert g.getBatchSize() == 2
+    assert f.getBatchSize() == 64

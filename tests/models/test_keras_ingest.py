@@ -1,0 +1,108 @@
+"""Generic Keras→jax ingestion: oracle equivalence per layer family.
+
+Mirrors the reference's pattern of verifying graph conversion against the
+framework it came from (SURVEY.md §4 oracle pattern).
+"""
+
+import numpy as np
+import pytest
+
+keras = pytest.importorskip("keras")
+from keras import layers  # noqa: E402
+
+from sparkdl_tpu.models.keras_ingest import keras_to_model_function  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def np_rng():
+    return np.random.default_rng(0)
+
+
+def _check(model, x, rtol=1e-4, atol=1e-4):
+    mf = keras_to_model_function(model)
+    got = np.asarray(mf(x))
+    want = model.predict(x, verbose=0)
+    np.testing.assert_allclose(got, want, rtol=rtol, atol=atol)
+    return mf
+
+
+def test_sequential_dense(np_rng):
+    m = keras.Sequential([keras.Input((8,)),
+                          layers.Dense(16, activation="relu"),
+                          layers.Dropout(0.5),
+                          layers.Dense(4, activation="softmax")])
+    x = np_rng.normal(size=(5, 8)).astype(np.float32)
+    mf = _check(m, x)
+    assert mf.input_spec.shape == (None, 8)
+
+
+def test_functional_dag_with_merge_and_bn(np_rng):
+    inp = keras.Input((12, 12, 3))
+    c1 = layers.Conv2D(6, 3, padding="same", activation="relu")(inp)
+    c2 = layers.Conv2D(6, 1, padding="same")(inp)
+    s = layers.Add()([c1, c2])
+    b = layers.BatchNormalization()(s)
+    p = layers.MaxPooling2D(2)(b)
+    a = layers.AveragePooling2D(3, strides=2, padding="same")(p)
+    out = layers.Dense(5)(layers.GlobalAveragePooling2D()(a))
+    m = keras.Model(inp, out)
+    # perturb weights incl. BN moving stats so identity stats can't hide bugs
+    rng = np.random.default_rng(1)
+    m.set_weights([w + rng.normal(scale=0.05, size=w.shape).astype(np.float32)
+                   for w in m.get_weights()])
+    x = np_rng.normal(size=(3, 12, 12, 3)).astype(np.float32)
+    _check(m, x, rtol=1e-3)
+
+
+def test_depthwise_separable_padding_relu6(np_rng):
+    inp = keras.Input((10, 10, 4))
+    r = layers.Rescaling(1 / 127.5, offset=-1)(inp)
+    z = layers.ZeroPadding2D(((1, 0), (1, 0)))(r)
+    d = layers.DepthwiseConv2D(3, strides=2)(z)
+    d = layers.ReLU(max_value=6.0)(d)
+    sp = layers.SeparableConv2D(6, 3, padding="same")(d)
+    cc = layers.Concatenate()([sp, sp])
+    m = keras.Model(inp, layers.GlobalMaxPooling2D()(cc))
+    x = (np_rng.normal(size=(2, 10, 10, 4)) * 100).astype(np.float32)
+    _check(m, x, rtol=1e-3)
+
+
+def test_nested_model(np_rng):
+    sub = keras.Sequential([keras.Input((8,)),
+                            layers.Dense(8, activation="tanh")])
+    inp = keras.Input((8,))
+    m = keras.Model(inp, layers.Dense(2)(sub(inp)))
+    x = np_rng.normal(size=(4, 8)).astype(np.float32)
+    _check(m, x)
+
+
+def test_keras_default_activations_match(np_rng):
+    # keras leaky_relu default slope is 0.2 (jax's is 0.01); keras gelu is
+    # exact (jax's default is tanh-approximate) — both must match keras
+    x = np_rng.normal(size=(6, 5)).astype(np.float32) * 3
+    for act in ("leaky_relu", "gelu", "selu", "softplus"):
+        m = keras.Sequential([keras.Input((5,)),
+                              layers.Dense(4, activation=act)])
+        _check(m, x, rtol=1e-4, atol=1e-5)
+
+
+def test_upsampling_interpolations(np_rng):
+    x = np_rng.normal(size=(2, 4, 4, 3)).astype(np.float32)
+    for interp in ("nearest", "bilinear"):
+        m = keras.Sequential([
+            keras.Input((4, 4, 3)),
+            layers.UpSampling2D(2, interpolation=interp)])
+        _check(m, x, rtol=1e-4, atol=1e-5)
+
+
+def test_unsupported_layer_raises_at_ingestion():
+    m = keras.Sequential([keras.Input((4, 8)), layers.LSTM(3)])
+    with pytest.raises(ValueError, match="LSTM"):
+        keras_to_model_function(m)
+
+
+def test_multi_output_rejected():
+    inp = keras.Input((4,))
+    m = keras.Model(inp, [layers.Dense(2)(inp), layers.Dense(3)(inp)])
+    with pytest.raises(ValueError, match="single-output"):
+        keras_to_model_function(m)

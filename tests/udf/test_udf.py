@@ -1,0 +1,99 @@
+"""UDF registry + selectExpr serving-path tests (SURVEY.md §3.4 parity)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from sparkdl_tpu.core.model_function import ModelFunction, TensorSpec
+from sparkdl_tpu.engine.dataframe import DataFrame
+from sparkdl_tpu.image import imageIO
+from sparkdl_tpu.udf import (
+    registerImageUDF,
+    registerTensorUDF,
+    registerUDF,
+    udf_registry,
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_registry():
+    before = set(udf_registry.names())
+    yield
+    for name in set(udf_registry.names()) - before:
+        udf_registry.unregister(name)
+
+
+def test_row_udf_via_select_expr():
+    registerUDF("double_it", lambda v: v * 2)
+    df = DataFrame.fromColumns({"x": np.array([1.0, 2.0, 3.0])})
+    out = df.selectExpr("double_it(x) as y", "x").collect()
+    assert [r["y"] for r in out] == [2.0, 4.0, 6.0]
+    assert [r["x"] for r in out] == [1.0, 2.0, 3.0]
+
+
+def test_tensor_model_udf():
+    w = np.array([[1.0, 0.0], [0.0, 2.0], [1.0, 1.0]], dtype=np.float32)
+    mf = ModelFunction.fromFunction(lambda vs, x: x @ vs["w"], {"w": w},
+                                    TensorSpec((None, 3)))
+    registerTensorUDF("linmap", mf)
+    x = np.arange(12, dtype=np.float32).reshape(4, 3)
+    df = DataFrame.fromColumns({"v": x}, numPartitions=2)
+    out = df.selectExpr("linmap(v) as o").collect()
+    np.testing.assert_allclose(np.array([r["o"] for r in out]), x @ w,
+                               rtol=1e-5)
+
+
+def test_image_model_udf_with_preprocessor(rng):
+    arr = rng.integers(0, 255, size=(8, 8, 3), dtype=np.uint8)
+    df = DataFrame.fromRows(
+        [{"image": imageIO.imageArrayToStruct(arr)}],
+        schema=pa.schema([pa.field("image", imageIO.imageSchema)]))
+    mf = ModelFunction.fromFunction(lambda vs, x: x.mean(axis=(1, 2)), None,
+                                    TensorSpec((None, 8, 8, 3)))
+    registerImageUDF("feat", mf, preprocessor=lambda a: a * 0 + 10)
+    out = df.selectExpr("feat(image) as f").collect()
+    np.testing.assert_allclose(np.array(out[0]["f"]), [10.0, 10.0, 10.0],
+                               rtol=1e-5)
+    assert list(out[0].keys()) == ["f"]
+
+
+def test_keras_image_udf(rng, tmp_path):
+    keras = pytest.importorskip("keras")
+    from keras import layers
+
+    m = keras.Sequential([keras.Input((8, 8, 3)), layers.Flatten(),
+                          layers.Dense(2)])
+    from sparkdl_tpu.udf import registerKerasImageUDF
+
+    registerKerasImageUDF("kmodel", m)
+    arr = rng.integers(0, 255, size=(8, 8, 3), dtype=np.uint8)
+    df = DataFrame.fromRows(
+        [{"image": imageIO.imageArrayToStruct(arr)}],
+        schema=pa.schema([pa.field("image", imageIO.imageSchema)]))
+    out = df.selectExpr("kmodel(image) as p").collect()
+    want = m.predict(arr[None].astype(np.float32), verbose=0)[0]
+    np.testing.assert_allclose(np.array(out[0]["p"]), want, rtol=1e-3,
+                               atol=1e-4)
+
+
+def test_unknown_udf_raises():
+    df = DataFrame.fromColumns({"x": np.array([1.0])})
+    with pytest.raises(KeyError, match="nope"):
+        df.selectExpr("nope(x)")
+
+
+def test_select_expr_same_source_twice():
+    # aliasing must not destroy the source column for later expressions
+    df = DataFrame.fromColumns({"a": np.array([1.0, 2.0])})
+    out = df.selectExpr("a as x", "a as y", "a").collect()
+    assert list(out[0].keys()) == ["x", "y", "a"]
+    assert out[0] == {"x": 1.0, "y": 1.0, "a": 1.0}
+
+
+def test_select_expr_plain_and_alias():
+    df = DataFrame.fromColumns({"x": np.array([1.0, 2.0]),
+                                "y": np.array([3.0, 4.0])})
+    out = df.selectExpr("y as z", "x").collect()
+    assert list(out[0].keys()) == ["z", "x"]
+    with pytest.raises(ValueError, match="parse"):
+        df.selectExpr("sum(x) + 1")
