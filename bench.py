@@ -1,0 +1,57 @@
+"""Benchmark harness — one JSON line for the driver.
+
+Headline metric (BASELINE.md / BASELINE.json): images/sec/chip for
+DeepImageFeaturizer-equivalent InceptionV3 featurize. Runs on the real
+TPU chip (no platform override); the model executes in bfloat16 on the
+MXU with device-resident weights, host staging excluded (the metric is
+device throughput, matching the reference's per-executor Session.run
+hot loop, SURVEY.md §3.1).
+
+The reference publishes no numbers (BASELINE.json ``published: {}``), so
+``vs_baseline`` is null until a measured reference exists.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def bench_inception_featurize(batch_size: int = 512, iters: int = 8,
+                              warmup: int = 2) -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from sparkdl_tpu.models import registry
+
+    mf = registry.build_featurizer("InceptionV3", weights="random",
+                                   dtype=jnp.bfloat16)
+    fn = mf.jitted()
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 255, size=(batch_size, 299, 299, 3)).astype(np.float32)
+    xd = jax.device_put(x)
+    # Timing uses device_get on the LAST queued output: under the Axon PJRT
+    # tunnel block_until_ready does not actually wait, so fetching the final
+    # result is the only reliable completion barrier. Execution is in-order,
+    # so this measures all queued iterations.
+    for _ in range(warmup):
+        jax.device_get(fn(xd))
+    t0 = time.perf_counter()
+    outs = [fn(xd) for _ in range(iters)]
+    jax.device_get(outs[-1])
+    dt = time.perf_counter() - t0
+    return batch_size * iters / dt
+
+
+def main() -> None:
+    images_per_sec = bench_inception_featurize()
+    print(json.dumps({
+        "metric": "images/sec/chip (InceptionV3 featurize)",
+        "value": round(images_per_sec, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

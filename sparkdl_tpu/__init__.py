@@ -35,7 +35,12 @@ _LAZY_EXPORTS = {
     "DeepImageFeaturizer": ("sparkdl_tpu.ml", "DeepImageFeaturizer"),
     "DeepImagePredictor": ("sparkdl_tpu.ml", "DeepImagePredictor"),
     "KerasImageFileTransformer": ("sparkdl_tpu.ml", "KerasImageFileTransformer"),
+    "KerasImageFileEstimator": ("sparkdl_tpu.ml", "KerasImageFileEstimator"),
     "KerasTransformer": ("sparkdl_tpu.ml", "KerasTransformer"),
+    # training surface
+    "Trainer": ("sparkdl_tpu.train", "Trainer"),
+    "TPURunner": ("sparkdl_tpu.train", "TPURunner"),
+    "CheckpointManager": ("sparkdl_tpu.train", "CheckpointManager"),
     # udf serving surface
     "registerKerasImageUDF": ("sparkdl_tpu.udf", "registerKerasImageUDF"),
     "registerImageUDF": ("sparkdl_tpu.udf", "registerImageUDF"),

@@ -45,6 +45,12 @@ class TensorSpec:
     def element_shape(self) -> Tuple[int, ...]:
         return tuple(d for d in self.shape[1:])
 
+    def spatial_size(self) -> Optional[Tuple[int, int]]:
+        """(H, W) for a static NHWC spec; None when not image-shaped."""
+        if len(self.shape) == 4 and None not in self.shape[1:3]:
+            return (self.shape[1], self.shape[2])
+        return None
+
 
 class ModelFunction:
     """A pure ``apply(variables, x) -> y`` + variables + input spec.

@@ -15,6 +15,7 @@ from sparkdl_tpu.ml.base import (
     PipelineModel,
     Transformer,
 )
+from sparkdl_tpu.ml.estimator import KerasImageFileEstimator, KerasImageFileModel
 from sparkdl_tpu.ml.image_transformer import TPUImageTransformer
 from sparkdl_tpu.ml.keras_image import KerasImageFileTransformer
 from sparkdl_tpu.ml.keras_tensor import KerasTransformer
@@ -30,6 +31,8 @@ __all__ = [
     "DeepImageFeaturizer",
     "DeepImagePredictor",
     "Estimator",
+    "KerasImageFileEstimator",
+    "KerasImageFileModel",
     "KerasImageFileTransformer",
     "KerasTransformer",
     "Model",

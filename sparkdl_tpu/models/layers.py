@@ -59,10 +59,11 @@ def avg_pool_same(x, window: int = 3, stride: int = 1):
     the full window. Reproduce by average-pooling ones to get the count
     correction factor.
     """
-    summed = nn.pool(x, 0.0, jnp.add, (window, window), (stride, stride),
+    zero = jnp.asarray(0.0, x.dtype)  # init must match operand dtype (bf16)
+    summed = nn.pool(x, zero, jnp.add, (window, window), (stride, stride),
                      "SAME")
     ones = jnp.ones(x.shape[1:3] + (1,), dtype=x.dtype)[None]
-    counts = nn.pool(ones, 0.0, jnp.add, (window, window), (stride, stride),
+    counts = nn.pool(ones, zero, jnp.add, (window, window), (stride, stride),
                      "SAME")
     return summed / counts
 

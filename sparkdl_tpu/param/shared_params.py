@@ -182,17 +182,37 @@ class HasKerasModel(Params):
         "in-memory Keras model object (alternative to modelFile)",
         typeConverter=TypeConverters.identity)
 
+    def __init__(self) -> None:
+        super().__init__()
+        self._mf_cache = None
+
     def setModelFile(self, value: str) -> "HasKerasModel":
+        self._mf_cache = None
         return self._set(modelFile=value)
 
     def getModelFile(self) -> Optional[str]:
         return self.getOrDefault(self.modelFile) if self.isDefined(self.modelFile) else None
 
     def setModel(self, value: Any) -> "HasKerasModel":
+        self._mf_cache = None
         return self._set(model=value)
 
     def getModel(self) -> Any:
         return self.getOrDefault(self.model) if self.isDefined(self.model) else None
+
+    def _invalidate_model_cache_if_set(self, kwargs) -> None:
+        """For keyword_only setParams paths that bypass the setters."""
+        if {"model", "modelFile"} & set(kwargs):
+            self._mf_cache = None
+
+    def copy(self, extra=None):
+        # the ingested ModelFunction is immutable, so copies share the cache
+        # unless the extra map swaps the model itself
+        that = super().copy(extra)
+        if extra and any(getattr(p, "name", None) in ("model", "modelFile")
+                         for p in extra):
+            that._mf_cache = None
+        return that
 
     def loadKerasModelAsFunction(self):
         """Resolve model/modelFile to a ModelFunction (generic ingestion)."""
@@ -206,6 +226,12 @@ class HasKerasModel(Params):
                 raise ValueError("set either model or modelFile")
             model = load_keras_file(path)
         return keras_to_model_function(model)
+
+    def cachedModelFunction(self):
+        """loadKerasModelAsFunction with one ingestion per model value."""
+        if self._mf_cache is None:
+            self._mf_cache = self.loadKerasModelAsFunction()
+        return self._mf_cache
 
 
 class HasKerasOptimizer(Params):

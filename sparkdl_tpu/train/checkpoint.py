@@ -1,0 +1,64 @@
+"""Orbax checkpoint/resume of full training state.
+
+Parity: SURVEY.md §5.4 — the reference only *read* model-format
+checkpoints (``TFInputGraph.fromCheckpoint``) and had **no mid-training
+resume**; gang failure meant restarting the job. Here every training
+state component ``{params, opt_state, model_state, rng, step}`` is saved
+(optionally async) and restored exactly, which is what makes TPURunner's
+restart-from-checkpoint gang semantics work (§5.3).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+
+
+class CheckpointManager:
+    """Step-indexed Orbax checkpoints under one directory.
+
+    ``keep`` bounds retained steps; ``save`` is async (overlaps the next
+    train steps) unless ``synchronous=True`` is passed.
+    """
+
+    def __init__(self, directory: str, keep: int = 3) -> None:
+        import orbax.checkpoint as ocp
+
+        self.directory = os.path.abspath(directory)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=keep,
+                                                 create=True),
+        )
+
+    def save(self, step: int, state: Any, synchronous: bool = False) -> None:
+        import orbax.checkpoint as ocp
+
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        if synchronous:
+            self._mgr.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(self, state_template: Any, step: Optional[int] = None) -> Any:
+        """Restore into the abstract/concrete template's pytree structure."""
+        import orbax.checkpoint as ocp
+
+        step = step if step is not None else self._mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(
+                f"No checkpoint found under {self.directory}")
+        template = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
+            if hasattr(x, "shape") and hasattr(x, "dtype") else x,
+            state_template)
+        return self._mgr.restore(step, args=ocp.args.StandardRestore(template))
+
+    def wait_until_finished(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()

@@ -1,0 +1,69 @@
+"""Structured per-step training/inference metrics.
+
+Parity: SURVEY.md §5.5 — the reference had only Python logging + Spark UI.
+Here: a metrics dict per step (loss, accuracy, examples/sec, HBM stats),
+pluggable sinks (stdout JSONL first), consumed by bench.py for the
+BASELINE-comparable numbers.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+
+
+def hbm_stats(device=None) -> Dict[str, int]:
+    """Bytes in use / limit for one device; {} where unsupported (CPU)."""
+    device = device or jax.local_devices()[0]
+    try:
+        stats = device.memory_stats()
+    except (AttributeError, RuntimeError, jax.errors.JaxRuntimeError):
+        return {}
+    if not stats:
+        return {}
+    out = {}
+    for key in ("bytes_in_use", "bytes_limit", "peak_bytes_in_use"):
+        if key in stats:
+            out[key] = int(stats[key])
+    return out
+
+
+class MetricsLogger:
+    """Collects per-step metric dicts and forwards them to sinks.
+
+    A sink is ``callable(record: dict) -> None``. ``jsonl`` writes one JSON
+    object per record to the given stream (stdout default).
+    """
+
+    def __init__(self, sinks: Optional[List[Callable]] = None,
+                 jsonl_stream=None, every: int = 1) -> None:
+        self.sinks = list(sinks or [])
+        if jsonl_stream is not None or not self.sinks:
+            stream = jsonl_stream or sys.stdout
+            self.sinks.append(
+                lambda rec: print(json.dumps(rec, default=float), file=stream))
+        self.every = max(1, every)
+        self.history: List[Dict[str, Any]] = []
+        self._t_last: Optional[float] = None
+
+    def log_step(self, step: int, metrics: Dict[str, Any],
+                 examples: Optional[int] = None) -> Dict[str, Any]:
+        now = time.perf_counter()
+        record = {"step": int(step)}
+        for k, v in metrics.items():
+            record[k] = float(v) if hasattr(v, "item") or isinstance(
+                v, (int, float)) else v
+        if examples is not None and self._t_last is not None:
+            dt = now - self._t_last
+            if dt > 0:
+                record["examples_per_sec"] = examples / dt
+        self._t_last = now
+        self.history.append(record)
+        if step % self.every == 0:
+            for sink in self.sinks:
+                sink(record)
+        return record

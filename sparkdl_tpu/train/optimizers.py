@@ -1,0 +1,121 @@
+"""Keras-name → optax optimizer/loss registry.
+
+Parity: the reference's ``HasKerasOptimizer``/``HasKerasLoss`` params took
+keras string names and compiled the keras model with them (SURVEY.md §3.3).
+The rebuild keeps the spelling but lowers onto optax, the idiomatic JAX
+optimizer library — update rules trace into the same XLA program as the
+backward pass.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+_OPTIMIZERS = {
+    "adam": lambda lr, **kw: optax.adam(lr, **kw),
+    "adamw": lambda lr, **kw: optax.adamw(lr, **kw),
+    "sgd": lambda lr, **kw: optax.sgd(lr, **kw),
+    "rmsprop": lambda lr, **kw: optax.rmsprop(lr, **kw),
+    "adagrad": lambda lr, **kw: optax.adagrad(lr, **kw),
+    "nadam": lambda lr, **kw: optax.nadam(lr, **kw),
+    "adamax": lambda lr, **kw: optax.adamax(lr, **kw),
+}
+
+_DEFAULT_LR = {"sgd": 0.01, "adam": 1e-3, "adamw": 1e-3, "rmsprop": 1e-3,
+               "adagrad": 1e-2, "nadam": 1e-3, "adamax": 1e-3}
+
+
+def make_optimizer(name_or_tx: Union[str, optax.GradientTransformation],
+                   learning_rate: float = None,
+                   **kwargs) -> optax.GradientTransformation:
+    """Resolve a keras-style optimizer name (or pass through an optax tx)."""
+    if not isinstance(name_or_tx, str):
+        return name_or_tx
+    name = name_or_tx.lower()
+    try:
+        ctor = _OPTIMIZERS[name]
+    except KeyError:
+        raise ValueError(
+            f"Unsupported optimizer {name_or_tx!r}; supported: "
+            f"{sorted(_OPTIMIZERS)}") from None
+    lr = learning_rate if learning_rate is not None else _DEFAULT_LR[name]
+    return ctor(lr, **kwargs)
+
+
+# -- losses ------------------------------------------------------------------
+# Each: fn(outputs, labels) -> scalar mean loss. Outputs follow the keras
+# convention for the matching loss (probabilities for *_crossentropy, since
+# keras models end in softmax/sigmoid activations; see from_logits below).
+
+_EPS = 1e-7
+
+
+def _categorical_crossentropy(probs, labels):
+    probs = jnp.clip(probs, _EPS, 1.0 - _EPS)
+    return -jnp.mean(jnp.sum(labels * jnp.log(probs), axis=-1))
+
+
+def _sparse_categorical_crossentropy(probs, labels):
+    probs = jnp.clip(probs, _EPS, 1.0 - _EPS)
+    ll = jnp.take_along_axis(jnp.log(probs), labels[..., None].astype(jnp.int32),
+                             axis=-1)
+    return -jnp.mean(ll)
+
+
+def _binary_crossentropy(probs, labels):
+    probs = jnp.clip(probs, _EPS, 1.0 - _EPS)
+    return -jnp.mean(labels * jnp.log(probs)
+                     + (1.0 - labels) * jnp.log(1.0 - probs))
+
+
+_LOSSES = {
+    "categorical_crossentropy": _categorical_crossentropy,
+    "sparse_categorical_crossentropy": _sparse_categorical_crossentropy,
+    "binary_crossentropy": _binary_crossentropy,
+    "mse": lambda y, t: jnp.mean((y - t) ** 2),
+    "mean_squared_error": lambda y, t: jnp.mean((y - t) ** 2),
+    "mae": lambda y, t: jnp.mean(jnp.abs(y - t)),
+    "mean_absolute_error": lambda y, t: jnp.mean(jnp.abs(y - t)),
+}
+
+_LOGIT_LOSSES = {
+    "categorical_crossentropy": (
+        lambda logits, labels: optax.softmax_cross_entropy(logits, labels).mean()),
+    "sparse_categorical_crossentropy": (
+        lambda logits, labels: optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels.astype(jnp.int32)).mean()),
+    "binary_crossentropy": (
+        lambda logits, labels: optax.sigmoid_binary_cross_entropy(
+            logits, labels).mean()),
+}
+
+
+def make_loss(name_or_fn: Union[str, Callable],
+              from_logits: bool = False) -> Callable:
+    """Resolve a keras-style loss name (or pass through a callable).
+
+    ``from_logits=True`` swaps in the numerically-stable fused logit form
+    (use when the model's head has no terminal activation).
+    """
+    if not isinstance(name_or_fn, str):
+        return name_or_fn
+    name = name_or_fn.lower()
+    table = _LOGIT_LOSSES if from_logits and name in _LOGIT_LOSSES else _LOSSES
+    try:
+        return table[name]
+    except KeyError:
+        raise ValueError(
+            f"Unsupported loss {name_or_fn!r}; supported: "
+            f"{sorted(_LOSSES)}") from None
+
+
+def accuracy_metric(outputs, labels) -> jax.Array:
+    """Top-1 accuracy; labels may be one-hot or integer class ids."""
+    pred = jnp.argmax(outputs, axis=-1)
+    if labels.ndim == outputs.ndim:
+        labels = jnp.argmax(labels, axis=-1)
+    return jnp.mean((pred == labels).astype(jnp.float32))

@@ -1,0 +1,78 @@
+"""TPURunner: mesh provisioning, restart-from-checkpoint gang semantics,
+fault injection (SURVEY.md §3.5, §5.3)."""
+
+import numpy as np
+import pytest
+import jax
+
+import flax.linen as nn
+
+from sparkdl_tpu.train import CheckpointManager, TPURunner, Trainer
+
+
+class MLP(nn.Module):
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return jax.nn.softmax(nn.Dense(3)(nn.relu(nn.Dense(8)(x))), axis=-1)
+
+
+def _data(n=32, d=4):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+    return [(x[i:i + 8], y[i:i + 8]) for i in range(0, n, 8)]
+
+
+def test_runner_passes_mesh_and_uses_np_devices():
+    seen = {}
+
+    def main(mesh=None):
+        seen["mesh"] = mesh
+        return "done"
+
+    assert TPURunner(np=4).run(main) == "done"
+    assert seen["mesh"].shape["data"] == 4
+
+
+def test_runner_np_too_large_rejected():
+    with pytest.raises(ValueError, match="devices"):
+        TPURunner(np=1024).run(lambda mesh=None: None)
+
+
+def test_runner_restarts_and_resumes_from_checkpoint(tmp_path):
+    """Kill the gang at step 2 on attempt 1; the restart must resume from
+    the checkpoint and finish all 8 steps."""
+    batches = _data()
+    module = MLP()
+    variables = module.init(jax.random.PRNGKey(0),
+                            np.zeros((1, 4), np.float32))
+    attempts = []
+
+    def train_fn(mesh=None):
+        attempt = len(attempts)
+        attempts.append(attempt)
+        trainer, state = Trainer.from_flax(module, variables, optimizer="sgd",
+                                           learning_rate=0.1, mesh=mesh)
+        ckpt = CheckpointManager(str(tmp_path / "gang"))
+
+        def fault(step):
+            if attempt == 0 and step == 2:
+                raise RuntimeError("injected worker loss")
+
+        state = trainer.fit(state, batches, epochs=2, checkpoint=ckpt,
+                            checkpoint_every=1, on_step=fault)
+        ckpt.wait_until_finished()
+        ckpt.close()
+        return int(state.step)
+
+    final = TPURunner(np=2, max_restarts=2).run(train_fn)
+    assert final == 8
+    assert len(attempts) == 2  # one failure, one successful restart
+
+
+def test_runner_exhausted_restarts_raise():
+    def always_fail(mesh=None):
+        raise RuntimeError("broken")
+
+    with pytest.raises(RuntimeError, match="after 2 attempts"):
+        TPURunner(np=2, max_restarts=1).run(always_fail)

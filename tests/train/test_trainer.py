@@ -1,0 +1,174 @@
+"""Trainer: loss descent, mesh DP equivalence, checkpoint/resume exactness.
+
+The distributed assertions run on the 8-device CPU mesh (conftest), per
+SURVEY.md §4's rebuild test plan.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import flax.linen as nn
+
+from sparkdl_tpu.core.mesh import MeshConfig, make_mesh
+from sparkdl_tpu.core.model_function import ModelFunction, TensorSpec
+from sparkdl_tpu.train import CheckpointManager, MetricsLogger, Trainer
+from sparkdl_tpu.train.optimizers import make_loss, make_optimizer
+
+
+class TinyMLP(nn.Module):
+    classes: int = 3
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Dense(16)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.classes)(x)
+        return jax.nn.softmax(x, axis=-1)
+
+
+class TinyBN(nn.Module):
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Dense(8)(x)
+        x = nn.BatchNorm(use_running_average=not train)(x)
+        return jax.nn.softmax(nn.Dense(2)(x), axis=-1)
+
+
+def _toy_data(n=64, d=6, classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=(d, classes)).astype(np.float32)
+    y = np.argmax(x @ w, axis=1)
+    y1h = np.eye(classes, dtype=np.float32)[y]
+    return x, y, y1h
+
+
+def _batches(x, y, bs):
+    return [(x[i:i + bs], y[i:i + bs]) for i in range(0, len(x) - bs + 1, bs)]
+
+
+def test_loss_descends():
+    x, _, y1h = _toy_data()
+    module = TinyMLP()
+    variables = module.init(jax.random.PRNGKey(0), x[:1])
+    trainer, state = Trainer.from_flax(module, variables, optimizer="sgd",
+                                       learning_rate=0.5)
+    logger = MetricsLogger(sinks=[lambda r: None])
+    state = trainer.fit(state, _batches(x, y1h, 16), epochs=10,
+                        metrics_logger=logger)
+    losses = [r["loss"] for r in logger.history]
+    assert losses[-1] < losses[0] * 0.7
+    assert int(state.step) == 4 * 10
+
+
+def test_batch_stats_update():
+    x, _, _ = _toy_data(classes=2)
+    y = np.eye(2, dtype=np.float32)[np.zeros(len(x), dtype=int)]
+    module = TinyBN()
+    variables = module.init(jax.random.PRNGKey(0), x[:1])
+    before = jax.device_get(variables["batch_stats"])
+    trainer, state = Trainer.from_flax(module, variables)
+    assert trainer.has_model_state
+    state = trainer.fit(state, _batches(x, y, 16), epochs=1)
+    after = jax.device_get(state.model_state["batch_stats"])
+    # moving stats must have moved
+    leaves_b = jax.tree.leaves(before)
+    leaves_a = jax.tree.leaves(after)
+    assert any(not np.allclose(a, b) for a, b in zip(leaves_a, leaves_b))
+
+
+def test_mesh_dp_matches_single_device():
+    """The load-bearing DP correctness test: same data, same init → the
+    8-way data-parallel step produces the same params as single-device."""
+    x, _, y1h = _toy_data(n=32)
+    module = TinyMLP()
+    variables = module.init(jax.random.PRNGKey(0), x[:1])
+
+    trainer1, state1 = Trainer.from_flax(module, variables, optimizer="sgd",
+                                         learning_rate=0.1)
+    state1 = trainer1.fit(state1, _batches(x, y1h, 16), epochs=2)
+
+    mesh = make_mesh(MeshConfig(data=8))
+    trainer8, state8 = Trainer.from_flax(module, variables, optimizer="sgd",
+                                         learning_rate=0.1, mesh=mesh)
+    state8 = trainer8.fit(state8, _batches(x, y1h, 16), epochs=2)
+
+    p1 = jax.device_get(state1.params)
+    p8 = jax.device_get(state8.params)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p8)):
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5)
+
+
+def test_checkpoint_resume_exact(tmp_path):
+    """Interrupted training resumed from checkpoint must land on exactly
+    the same params as uninterrupted training (gang-restart semantics)."""
+    x, _, y1h = _toy_data(n=64)
+    module = TinyMLP()
+    variables = module.init(jax.random.PRNGKey(0), x[:1])
+    batches = _batches(x, y1h, 16)  # 4 steps/epoch
+
+    # uninterrupted: 2 epochs = 8 steps
+    trainer, ref_state = Trainer.from_flax(module, variables, optimizer="sgd",
+                                           learning_rate=0.1)
+    ref_state = trainer.fit(ref_state, batches, epochs=2)
+
+    # interrupted at step 5 (mid epoch 2), checkpoint every step
+    ckpt_dir = str(tmp_path / "ckpt")
+    trainer2, state2 = Trainer.from_flax(module, variables, optimizer="sgd",
+                                         learning_rate=0.1)
+    ckpt = CheckpointManager(ckpt_dir)
+
+    class Boom(RuntimeError):
+        pass
+
+    def bomb(step):
+        if step == 5:
+            raise Boom()
+
+    with pytest.raises(Boom):
+        trainer2.fit(state2, batches, epochs=2, checkpoint=ckpt,
+                     checkpoint_every=1, on_step=bomb)
+    ckpt.wait_until_finished()
+    assert ckpt.latest_step() == 5
+
+    # restart from scratch-shaped state; fit resumes at step 5
+    _, fresh = Trainer.from_flax(module, variables, optimizer="sgd",
+                                 learning_rate=0.1)
+    resumed = trainer2.fit(fresh, batches, epochs=2, checkpoint=ckpt)
+    assert int(resumed.step) == 8
+    for a, b in zip(jax.tree.leaves(jax.device_get(ref_state.params)),
+                    jax.tree.leaves(jax.device_get(resumed.params))):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+    ckpt.close()
+
+
+def test_model_function_training():
+    # training an ingested-style plain ModelFunction (stateless path)
+    x, _, y1h = _toy_data()
+    w = np.zeros((6, 3), dtype=np.float32)
+    mf = ModelFunction.fromFunction(
+        lambda vs, x: jax.nn.softmax(x @ vs["w"], axis=-1), {"w": w},
+        TensorSpec((None, 6)))
+    trainer, state = Trainer.from_model_function(mf, optimizer="sgd",
+                                                 learning_rate=1.0)
+    logger = MetricsLogger(sinks=[lambda r: None])
+    state = trainer.fit(state, _batches(x, y1h, 32), epochs=20,
+                        metrics_logger=logger)
+    assert logger.history[-1]["accuracy"] > 0.8
+
+
+def test_make_optimizer_and_loss_validation():
+    with pytest.raises(ValueError, match="optimizer"):
+        make_optimizer("not_an_opt")
+    with pytest.raises(ValueError, match="loss"):
+        make_loss("not_a_loss")
+    # logits variant differs from probability variant
+    logits = jnp.array([[2.0, -1.0]])
+    labels = jnp.array([[1.0, 0.0]])
+    l_probs = make_loss("categorical_crossentropy")(
+        jax.nn.softmax(logits), labels)
+    l_logits = make_loss("categorical_crossentropy", from_logits=True)(
+        logits, labels)
+    np.testing.assert_allclose(float(l_probs), float(l_logits), rtol=1e-5)
