@@ -62,11 +62,16 @@ class ModelFunction:
 
     def __init__(self, apply_fn: Callable[[Any, jax.Array], jax.Array],
                  variables: Any, input_spec: TensorSpec,
-                 name: str = "model") -> None:
+                 name: str = "model",
+                 trainable_mask: Any = None) -> None:
         self.apply_fn = apply_fn
         self.variables = variables
         self.input_spec = input_spec
         self.name = name
+        # Optional bool pytree matching ``variables``: False leaves are
+        # non-trainable (e.g. ingested Keras BatchNorm moving stats) and the
+        # Trainer masks their updates. None = everything trainable.
+        self.trainable_mask = trainable_mask
         self._jit_cache: Dict[Tuple, Callable] = {}
 
     # -- construction matrix (TFInputGraph parity) ---------------------------
@@ -205,7 +210,8 @@ class ModelFunction:
             return apply_fn(vs, pre(x))
 
         return ModelFunction(fn, self.variables, input_spec or self.input_spec,
-                             name=self.name)
+                             name=self.name,
+                             trainable_mask=self.trainable_mask)
 
     def with_postprocess(self, post: Callable[[jax.Array], jax.Array]
                          ) -> "ModelFunction":
@@ -214,7 +220,8 @@ class ModelFunction:
         def fn(vs, x):
             return post(apply_fn(vs, x))
 
-        return ModelFunction(fn, self.variables, self.input_spec, name=self.name)
+        return ModelFunction(fn, self.variables, self.input_spec, name=self.name,
+                             trainable_mask=self.trainable_mask)
 
     def flattened(self) -> "ModelFunction":
         """Flatten outputs to (batch, -1) — the ``buildFlattener`` analog."""

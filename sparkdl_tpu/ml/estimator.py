@@ -189,6 +189,15 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         if n == 0:
             raise ValueError("No decodable training images")
         batch_size = min(batch_size, n)
+        if mesh is not None:
+            # the clamp above can break divisibility by the data axis; the
+            # jitted step's P('data') in_shardings needs every shard equal
+            axis = data_axis_size(mesh)
+            batch_size = (batch_size // axis) * axis
+            if batch_size == 0:
+                raise ValueError(
+                    f"dataset has {n} usable rows but the mesh data axis "
+                    f"spans {axis} devices; need at least {axis} rows")
         usable = (n // batch_size) * batch_size
         batches = [(x[i:i + batch_size], y[i:i + batch_size])
                    for i in range(0, usable, batch_size)]
@@ -198,7 +207,8 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
             learning_rate=lr, mesh=mesh)
         state = trainer.fit(state, batches, epochs=epochs)
         trained = ModelFunction(mf.apply_fn, jax.device_get(state.params),
-                                mf.input_spec, name=mf.name + "_trained")
+                                mf.input_spec, name=mf.name + "_trained",
+                                trainable_mask=mf.trainable_mask)
         model = KerasImageFileModel(
             inputCol=self.getInputCol(), outputCol=self.getOutputCol(),
             modelFunction=trained, outputMode=self.getOutputMode(),

@@ -65,6 +65,16 @@ def _pair(v) -> Tuple[int, int]:
     return tuple(int(x) for x in v)  # type: ignore[return-value]
 
 
+def _require_channels_last(layer) -> None:
+    """This module's converters are NHWC-only; reject channels_first at
+    ingestion (the module contract: never silently wrong at run time)."""
+    fmt = getattr(layer, "data_format", "channels_last")
+    if fmt != "channels_last":
+        raise ValueError(
+            f"Unsupported data_format {fmt!r} on layer {layer.name!r} "
+            f"({type(layer).__name__}); only channels_last is supported")
+
+
 def _conv(x, kernel, strides, padding, dilation=(1, 1), groups=1):
     return jax.lax.conv_general_dilated(
         x, kernel, window_strides=strides, padding=padding.upper(),
@@ -113,6 +123,7 @@ def _convert_layer(layer) -> Callable[[List[jnp.ndarray]], Callable]:
         return dense
 
     if cls == "Conv2D":
+        _require_channels_last(layer)
         act = _activation_fn(layer.activation)
         strides = _pair(layer.strides)
         padding = layer.padding
@@ -129,6 +140,7 @@ def _convert_layer(layer) -> Callable[[List[jnp.ndarray]], Callable]:
         return conv
 
     if cls == "DepthwiseConv2D":
+        _require_channels_last(layer)
         act = _activation_fn(layer.activation)
         strides = _pair(layer.strides)
         padding = layer.padding
@@ -144,6 +156,7 @@ def _convert_layer(layer) -> Callable[[List[jnp.ndarray]], Callable]:
         return dwconv
 
     if cls == "SeparableConv2D":
+        _require_channels_last(layer)
         act = _activation_fn(layer.activation)
         strides = _pair(layer.strides)
         padding = layer.padding
@@ -160,6 +173,18 @@ def _convert_layer(layer) -> Callable[[List[jnp.ndarray]], Callable]:
         return sepconv
 
     if cls == "BatchNormalization":
+        axis = layer.axis
+        if isinstance(axis, (list, tuple)):
+            axis = axis[0] if len(axis) == 1 else None
+        rank = None
+        try:
+            rank = len(layer.input.shape)
+        except Exception:  # noqa: BLE001 - layer outside a built graph
+            pass
+        if axis is None or (axis != -1 and (rank is None or axis != rank - 1)):
+            raise ValueError(
+                f"Unsupported BatchNormalization axis {layer.axis!r} on layer "
+                f"{layer.name!r}; only the last (channel) axis is supported")
         eps = float(layer.epsilon)
         scale, center = layer.scale, layer.center
 
@@ -217,6 +242,7 @@ def _convert_layer(layer) -> Callable[[List[jnp.ndarray]], Callable]:
         return lambda w, x: x
 
     if cls in ("MaxPooling2D", "AveragePooling2D"):
+        _require_channels_last(layer)
         pool = _pair(layer.pool_size)
         strides = _pair(layer.strides or layer.pool_size)
         padding = layer.padding
@@ -224,14 +250,17 @@ def _convert_layer(layer) -> Callable[[List[jnp.ndarray]], Callable]:
         return lambda w, x: _pool(x, pool, strides, padding, kind)
 
     if cls == "GlobalAveragePooling2D":
+        _require_channels_last(layer)
         keepdims = getattr(layer, "keepdims", False)
         return lambda w, x: x.mean(axis=(1, 2), keepdims=keepdims)
 
     if cls == "GlobalMaxPooling2D":
+        _require_channels_last(layer)
         keepdims = getattr(layer, "keepdims", False)
         return lambda w, x: x.max(axis=(1, 2), keepdims=keepdims)
 
     if cls == "ZeroPadding2D":
+        _require_channels_last(layer)
         pad = layer.padding  # ((top, bottom), (left, right)) after keras norm
         if isinstance(pad, int):
             pad = ((pad, pad), (pad, pad))
@@ -240,6 +269,7 @@ def _convert_layer(layer) -> Callable[[List[jnp.ndarray]], Callable]:
         return lambda w, x: jnp.pad(x, cfg)
 
     if cls == "Cropping2D":
+        _require_channels_last(layer)
         crop = tuple(_pair(p) for p in layer.cropping)
 
         def cropping(w, x):
@@ -249,6 +279,7 @@ def _convert_layer(layer) -> Callable[[List[jnp.ndarray]], Callable]:
         return cropping
 
     if cls == "UpSampling2D":
+        _require_channels_last(layer)
         size = _pair(layer.size)
         interp = getattr(layer, "interpolation", "nearest")
         if interp == "nearest":
@@ -369,6 +400,26 @@ def _collect_weights(model) -> Dict[str, List[np.ndarray]]:
     return out
 
 
+def _collect_trainable_mask(model) -> Dict[str, List[bool]]:
+    """Bool pytree matching :func:`_collect_weights`: True = trainable.
+
+    Keras marks e.g. BatchNorm ``moving_mean``/``moving_variance`` (and any
+    frozen layer's weights) non-trainable; the Trainer masks their updates
+    so fine-tuning cannot corrupt normalization statistics
+    (``layer.weights`` order is ``get_weights()`` order).
+    """
+    import keras
+
+    out: Dict[str, List[bool]] = {}
+    for layer in model.layers:
+        if isinstance(layer, keras.Model):
+            out[layer.name] = _collect_trainable_mask(layer)  # type: ignore[assignment]
+        else:
+            if layer.weights:
+                out[layer.name] = [bool(v.trainable) for v in layer.weights]
+    return out
+
+
 def keras_to_model_function(model, name: str = None) -> ModelFunction:
     """Ingest a built Keras model (Sequential or functional) as a
     ModelFunction; the layer DAG becomes one jax-traceable pure function."""
@@ -383,6 +434,7 @@ def keras_to_model_function(model, name: str = None) -> ModelFunction:
 
     steps, out_ids, in_ids = _walk_graph(model)
     weights = _collect_weights(model)
+    mask = _collect_trainable_mask(model)
     in_shape = model.inputs[0].shape
     spec = TensorSpec(tuple(None if d is None else int(d) for d in in_shape),
                       "float32")
@@ -391,4 +443,4 @@ def keras_to_model_function(model, name: str = None) -> ModelFunction:
         return _run_steps(steps, {in_ids[0]: x}, vs, out_ids)[0]
 
     return ModelFunction(apply_fn, jax.tree.map(jnp.asarray, weights), spec,
-                         name=name or model.name)
+                         name=name or model.name, trainable_mask=mask)

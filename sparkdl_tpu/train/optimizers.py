@@ -54,6 +54,18 @@ def make_optimizer(name_or_tx: Union[str, optax.GradientTransformation],
 _EPS = 1e-7
 
 
+def _align_ranks(outputs, labels):
+    """keras ``squeeze_or_expand_dimensions``: make elementwise losses see
+    matching ranks so (N,) labels vs (N, 1) sigmoid heads never broadcast
+    to (N, N)."""
+    labels = jnp.asarray(labels)
+    if labels.ndim == outputs.ndim - 1 and outputs.shape[-1] == 1:
+        labels = labels[..., None]
+    elif outputs.ndim == labels.ndim - 1 and labels.shape[-1] == 1:
+        outputs = outputs[..., None]
+    return outputs, labels
+
+
 def _categorical_crossentropy(probs, labels):
     probs = jnp.clip(probs, _EPS, 1.0 - _EPS)
     return -jnp.mean(jnp.sum(labels * jnp.log(probs), axis=-1))
@@ -67,20 +79,37 @@ def _sparse_categorical_crossentropy(probs, labels):
 
 
 def _binary_crossentropy(probs, labels):
+    probs, labels = _align_ranks(probs, labels)
     probs = jnp.clip(probs, _EPS, 1.0 - _EPS)
     return -jnp.mean(labels * jnp.log(probs)
                      + (1.0 - labels) * jnp.log(1.0 - probs))
+
+
+def _mse(outputs, labels):
+    outputs, labels = _align_ranks(outputs, labels)
+    return jnp.mean((outputs - labels) ** 2)
+
+
+def _mae(outputs, labels):
+    outputs, labels = _align_ranks(outputs, labels)
+    return jnp.mean(jnp.abs(outputs - labels))
 
 
 _LOSSES = {
     "categorical_crossentropy": _categorical_crossentropy,
     "sparse_categorical_crossentropy": _sparse_categorical_crossentropy,
     "binary_crossentropy": _binary_crossentropy,
-    "mse": lambda y, t: jnp.mean((y - t) ** 2),
-    "mean_squared_error": lambda y, t: jnp.mean((y - t) ** 2),
-    "mae": lambda y, t: jnp.mean(jnp.abs(y - t)),
-    "mean_absolute_error": lambda y, t: jnp.mean(jnp.abs(y - t)),
+    "mse": _mse,
+    "mean_squared_error": _mse,
+    "mae": _mae,
+    "mean_absolute_error": _mae,
 }
+
+
+def _sigmoid_bce_logits(logits, labels):
+    logits, labels = _align_ranks(logits, labels)
+    return optax.sigmoid_binary_cross_entropy(logits, labels).mean()
+
 
 _LOGIT_LOSSES = {
     "categorical_crossentropy": (
@@ -88,9 +117,7 @@ _LOGIT_LOSSES = {
     "sparse_categorical_crossentropy": (
         lambda logits, labels: optax.softmax_cross_entropy_with_integer_labels(
             logits, labels.astype(jnp.int32)).mean()),
-    "binary_crossentropy": (
-        lambda logits, labels: optax.sigmoid_binary_cross_entropy(
-            logits, labels).mean()),
+    "binary_crossentropy": _sigmoid_bce_logits,
 }
 
 
@@ -113,8 +140,21 @@ def make_loss(name_or_fn: Union[str, Callable],
             f"{sorted(_LOSSES)}") from None
 
 
-def accuracy_metric(outputs, labels) -> jax.Array:
-    """Top-1 accuracy; labels may be one-hot or integer class ids."""
+def accuracy_metric(outputs, labels, from_logits: bool = False) -> jax.Array:
+    """Top-1 accuracy; labels may be one-hot or integer class ids.
+
+    Binary heads (``outputs.shape[-1] == 1``) threshold the probability at
+    0.5 — or the logit at 0 when ``from_logits`` — instead of argmax (which
+    would always predict class 0). Argmax is logits/probs-invariant, so
+    ``from_logits`` only matters for the binary path."""
+    labels = jnp.asarray(labels)
+    if outputs.shape[-1] == 1:
+        threshold = 0.0 if from_logits else 0.5
+        pred = (outputs[..., 0] >= threshold).astype(jnp.float32)
+        if labels.ndim == outputs.ndim:
+            labels = labels[..., 0]
+        return jnp.mean((pred == labels.astype(jnp.float32))
+                        .astype(jnp.float32))
     pred = jnp.argmax(outputs, axis=-1)
     if labels.ndim == outputs.ndim:
         labels = jnp.argmax(labels, axis=-1)

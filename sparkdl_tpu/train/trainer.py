@@ -61,6 +61,7 @@ class Trainer:
     mesh: Any = None
     has_model_state: bool = False
     compute_accuracy: bool = True
+    accuracy_from_logits: bool = False
 
     # -- constructors --------------------------------------------------------
 
@@ -89,7 +90,8 @@ class Trainer:
         trainer = cls(apply_fn=apply_fn,
                       loss=make_loss(loss, from_logits=from_logits),
                       optimizer=make_optimizer(optimizer, learning_rate),
-                      mesh=mesh, has_model_state=bool(mutable_keys), **kwargs)
+                      mesh=mesh, has_model_state=bool(mutable_keys),
+                      accuracy_from_logits=from_logits, **kwargs)
         state = trainer.init_state(params, model_state)
         return trainer, state
 
@@ -102,17 +104,24 @@ class Trainer:
         """ModelFunction (e.g. an ingested Keras DAG) → (trainer, state).
 
         The model runs in inference form during training (normalization
-        uses stored moving stats — fine-tune semantics); all weights
-        receive gradients.
+        uses stored moving stats — fine-tune semantics). Weights the
+        ingestion marked non-trainable (``mf.trainable_mask``, e.g. Keras
+        BatchNorm moving stats) are frozen so their gradients through the
+        inference-mode forward are never applied.
         """
 
         def apply_fn(vs, x, train, rngs):
             return mf.apply_fn(vs["params"], x)
 
-        trainer = cls(apply_fn=apply_fn,
-                      loss=make_loss(loss, from_logits=from_logits),
-                      optimizer=make_optimizer(optimizer, learning_rate),
-                      mesh=mesh, has_model_state=False, **kwargs)
+        tx = make_optimizer(optimizer, learning_rate)
+        mask = getattr(mf, "trainable_mask", None)
+        if mask is not None and not all(jax.tree.leaves(mask)):
+            labels = jax.tree.map(lambda t: "train" if t else "freeze", mask)
+            tx = optax.multi_transform(
+                {"train": tx, "freeze": optax.set_to_zero()}, labels)
+        trainer = cls(apply_fn=apply_fn, loss=make_loss(loss, from_logits=from_logits),
+                      optimizer=tx, mesh=mesh, has_model_state=False,
+                      accuracy_from_logits=from_logits, **kwargs)
         state = trainer.init_state(mf.variables, {})
         return trainer, state
 
@@ -145,6 +154,7 @@ class Trainer:
         optimizer = self.optimizer
         has_state = self.has_model_state
         want_acc = self.compute_accuracy
+        acc_from_logits = self.accuracy_from_logits
 
         def step_fn(state: TrainState, x, y):
             rng, step_rng = jax.random.split(state.rng)
@@ -169,7 +179,8 @@ class Trainer:
                                    model_state=new_model_state, rng=rng)
             metrics = {"loss": loss}
             if want_acc and out.ndim >= 2:
-                metrics["accuracy"] = accuracy_metric(out, y)
+                metrics["accuracy"] = accuracy_metric(
+                    out, y, from_logits=acc_from_logits)
             return new_state, metrics
 
         kwargs: Dict[str, Any] = {"donate_argnums": (0,)} if donate else {}

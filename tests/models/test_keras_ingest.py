@@ -106,3 +106,57 @@ def test_multi_output_rejected():
     m = keras.Model(inp, [layers.Dense(2)(inp), layers.Dense(3)(inp)])
     with pytest.raises(ValueError, match="single-output"):
         keras_to_model_function(m)
+
+
+def test_channels_first_rejected_at_ingestion():
+    m = keras.Sequential([keras.Input((3, 10, 10)),
+                          layers.Conv2D(4, 3, data_format="channels_first")])
+    with pytest.raises(ValueError, match="channels_last"):
+        keras_to_model_function(m)
+
+
+def test_bn_nonchannel_axis_rejected_at_ingestion():
+    m = keras.Sequential([keras.Input((6, 6, 3)),
+                          layers.BatchNormalization(axis=1),
+                          layers.Flatten(), layers.Dense(2)])
+    with pytest.raises(ValueError, match="BatchNormalization axis"):
+        keras_to_model_function(m)
+
+
+def test_trainable_mask_marks_bn_moving_stats(np_rng):
+    m = keras.Sequential([keras.Input((4,)),
+                          layers.Dense(3),
+                          layers.BatchNormalization(),
+                          layers.Dense(2, activation="softmax")])
+    mf = keras_to_model_function(m)
+    mask = mf.trainable_mask
+    assert mask is not None
+    bn_name = m.layers[1].name
+    # gamma, beta trainable; moving_mean, moving_variance frozen
+    assert mask[bn_name] == [True, True, False, False]
+    dense_name = m.layers[0].name
+    assert all(mask[dense_name])
+
+
+def test_finetune_does_not_corrupt_bn_moving_stats(np_rng):
+    import jax
+
+    from sparkdl_tpu.train.trainer import Trainer
+
+    m = keras.Sequential([keras.Input((4,)),
+                          layers.Dense(8, activation="relu"),
+                          layers.BatchNormalization(),
+                          layers.Dense(3, activation="softmax")])
+    mf = keras_to_model_function(m)
+    bn_name = m.layers[1].name
+    before = jax.device_get(mf.variables[bn_name])
+    x = np_rng.normal(size=(32, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[np_rng.integers(0, 3, size=32)]
+    trainer, state = Trainer.from_model_function(
+        mf, optimizer="adam", learning_rate=0.05)
+    state = trainer.fit(state, [(x, y)], epochs=5)
+    after = jax.device_get(state.params[bn_name])
+    # moving stats (positions 2, 3) must be untouched; gamma/beta must train
+    np.testing.assert_array_equal(after[2], before[2])
+    np.testing.assert_array_equal(after[3], before[3])
+    assert not np.allclose(after[0], before[0])

@@ -172,3 +172,51 @@ def test_make_optimizer_and_loss_validation():
     l_logits = make_loss("categorical_crossentropy", from_logits=True)(
         logits, labels)
     np.testing.assert_allclose(float(l_probs), float(l_logits), rtol=1e-5)
+
+
+def test_binary_crossentropy_rank_alignment():
+    """(N,) labels vs (N,1) sigmoid head must NOT broadcast to (N,N)
+    (ADVICE r1: silently wrong loss 0.89 vs correct 0.18)."""
+    probs = jnp.array([[0.9], [0.2], [0.8], [0.7]])
+    labels = jnp.array([1.0, 0.0, 1.0, 1.0])
+    loss = make_loss("binary_crossentropy")(probs, labels)
+    want = -np.mean([np.log(0.9), np.log(0.8), np.log(0.8), np.log(0.7)])
+    np.testing.assert_allclose(float(loss), want, rtol=1e-5)
+    # logits form aligns too
+    logits = jnp.log(probs / (1 - probs))
+    loss_l = make_loss("binary_crossentropy", from_logits=True)(logits, labels)
+    np.testing.assert_allclose(float(loss_l), want, rtol=1e-5)
+
+
+def test_accuracy_metric_binary_head():
+    from sparkdl_tpu.train.optimizers import accuracy_metric
+
+    probs = jnp.array([[0.9], [0.2], [0.8], [0.4]])
+    labels = jnp.array([1.0, 0.0, 0.0, 1.0])
+    np.testing.assert_allclose(float(accuracy_metric(probs, labels)), 0.5)
+    # rank-2 labels too
+    np.testing.assert_allclose(
+        float(accuracy_metric(probs, labels[:, None])), 0.5)
+
+
+def test_binary_head_training_learns():
+    """End-to-end: Dense(1, sigmoid) head + (N,) labels trains correctly."""
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(128, 4)).astype(np.float32)
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.float32)
+
+    class BinaryHead(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            return jax.nn.sigmoid(nn.Dense(1)(x))
+
+    module = BinaryHead()
+    variables = module.init(jax.random.PRNGKey(0), x[:1])
+    trainer, state = Trainer.from_flax(
+        module, variables, loss="binary_crossentropy", optimizer="sgd",
+        learning_rate=1.0)
+    logger = MetricsLogger(sinks=[lambda r: None])
+    state = trainer.fit(state, _batches(x, y, 32), epochs=15,
+                        metrics_logger=logger)
+    assert logger.history[-1]["loss"] < logger.history[0]["loss"] * 0.5
+    assert logger.history[-1]["accuracy"] > 0.9
