@@ -100,3 +100,43 @@ def data_axis_size(mesh: Mesh) -> int:
 
 def pad_to_multiple(n: int, multiple: int) -> int:
     return int(math.ceil(n / multiple) * multiple)
+
+
+# ---------------------------------------------------------------------------
+# Framework default mesh
+# ---------------------------------------------------------------------------
+# The reference scaled inference by running on every Spark executor
+# implicitly; the rebuild's analog is one framework-level default mesh that
+# every transformer/UDF uses unless given an explicit ``mesh`` param — so
+# ``set_default_mesh(data_parallel_mesh())`` makes the whole API multi-chip.
+
+_default_mesh: Optional[Mesh] = None
+
+
+def set_default_mesh(mesh: Optional[Mesh]) -> Optional[Mesh]:
+    """Set (or clear, with None) the process-wide default mesh."""
+    global _default_mesh
+    _default_mesh = mesh
+    return mesh
+
+
+def get_default_mesh() -> Optional[Mesh]:
+    return _default_mesh
+
+
+class use_mesh:
+    """Context manager: ``with use_mesh(mesh): ...`` scopes the default."""
+
+    def __init__(self, mesh: Optional[Mesh]) -> None:
+        self._mesh = mesh
+        self._prev: Optional[Mesh] = None
+
+    def __enter__(self) -> Optional[Mesh]:
+        global _default_mesh
+        self._prev = _default_mesh
+        _default_mesh = self._mesh
+        return self._mesh
+
+    def __exit__(self, *exc) -> None:
+        global _default_mesh
+        _default_mesh = self._prev

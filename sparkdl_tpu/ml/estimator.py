@@ -33,6 +33,7 @@ from sparkdl_tpu.param.shared_params import (
     HasKerasModel,
     HasKerasOptimizer,
     HasLabelCol,
+    HasMesh,
     HasOutputCol,
     HasOutputMode,
 )
@@ -43,17 +44,13 @@ _LOADED_COL = "__sdl_estimator_image"
 class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
                               HasLabelCol, HasKerasModel, HasKerasOptimizer,
                               HasKerasLoss, CanLoadImage, HasOutputMode,
-                              HasBatchSize):
+                              HasBatchSize, HasMesh):
     """Estimator over an image-URI DataFrame, fitted on TPU via Trainer."""
 
     kerasFitParams = Param(
         "KerasImageFileEstimator", "kerasFitParams",
         "fit options: {'epochs': int, 'batch_size': int, "
         "'learning_rate': float, 'shuffle': bool, 'seed': int}",
-        typeConverter=TypeConverters.identity)
-    mesh = Param(
-        "KerasImageFileEstimator", "mesh",
-        "optional jax.sharding.Mesh; batch shards over its 'data' axis",
         typeConverter=TypeConverters.identity)
 
     @keyword_only
@@ -73,7 +70,7 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         self._setDefault(kerasOptimizer="adam",
                          kerasLoss="categorical_crossentropy",
                          kerasFitParams={"epochs": 1, "batch_size": 32},
-                         outputMode="vector", batchSize=64, mesh=None)
+                         outputMode="vector", batchSize=64)
         self._mf_cache = None
         kwargs = self._input_kwargs
         self.setParams(**kwargs)
@@ -177,7 +174,7 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         shuffle = bool(fit_params.get("shuffle", True))
         seed = int(fit_params.get("seed", 0))
         lr = fit_params.get("learning_rate")
-        mesh = self.getOrDefault(self.mesh)
+        mesh = self.resolveMesh()
         if mesh is not None:
             batch_size = pad_to_multiple(batch_size, data_axis_size(mesh))
         if shuffle:
@@ -212,7 +209,7 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         model = KerasImageFileModel(
             inputCol=self.getInputCol(), outputCol=self.getOutputCol(),
             modelFunction=trained, outputMode=self.getOutputMode(),
-            batchSize=self.getBatchSize(),
+            batchSize=self.getBatchSize(), mesh=self.getMesh(),
             imageLoader=self.getImageLoader())
         model._set_parent(self)
         return model
@@ -249,7 +246,7 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
 
 
 class KerasImageFileModel(Model, HasInputCol, HasOutputCol, CanLoadImage,
-                          HasOutputMode, HasBatchSize):
+                          HasOutputMode, HasBatchSize, HasMesh):
     """Fitted model: URI column → trained network → predictions column."""
 
     modelFunction = Param("KerasImageFileModel", "modelFunction",
@@ -262,6 +259,7 @@ class KerasImageFileModel(Model, HasInputCol, HasOutputCol, CanLoadImage,
                  modelFunction=None,
                  outputMode: str = "vector",
                  batchSize: int = 64,
+                 mesh=None,
                  imageLoader: Optional[Callable] = None) -> None:
         super().__init__()
         self._setDefault(outputMode="vector", batchSize=64)
@@ -284,5 +282,5 @@ class KerasImageFileModel(Model, HasInputCol, HasOutputCol, CanLoadImage,
         inner = TPUImageTransformer(
             inputCol=_LOADED_COL, outputCol=self.getOutputCol(),
             modelFunction=mf, outputMode=self.getOutputMode(),
-            batchSize=self.getBatchSize())
+            batchSize=self.getBatchSize(), mesh=self.getMesh())
         return inner.transform(loaded).drop(_LOADED_COL)

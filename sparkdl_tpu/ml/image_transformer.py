@@ -30,6 +30,7 @@ from sparkdl_tpu.param.converters import TypeConverters
 from sparkdl_tpu.param.shared_params import (
     HasBatchSize,
     HasInputCol,
+    HasMesh,
     HasModelFunction,
     HasOutputCol,
     HasOutputMode,
@@ -39,7 +40,8 @@ OUTPUT_MODES = ("vector", "image")
 
 
 class TPUImageTransformer(Transformer, HasInputCol, HasOutputCol,
-                          HasModelFunction, HasOutputMode, HasBatchSize):
+                          HasModelFunction, HasOutputMode, HasBatchSize,
+                          HasMesh):
     """Apply a ModelFunction to an image-struct column.
 
     ``outputMode="vector"`` flattens model output per row into a fixed-size
@@ -60,7 +62,8 @@ class TPUImageTransformer(Transformer, HasInputCol, HasOutputCol,
                  modelFunction=None,
                  outputMode: str = "vector",
                  batchSize: int = 64,
-                 inputSize: Optional[Tuple[int, int]] = None) -> None:
+                 inputSize: Optional[Tuple[int, int]] = None,
+                 mesh=None) -> None:
         super().__init__()
         self._setDefault(outputMode="vector", batchSize=64, inputSize=None)
         kwargs = self._input_kwargs
@@ -72,8 +75,8 @@ class TPUImageTransformer(Transformer, HasInputCol, HasOutputCol,
                   modelFunction=None,
                   outputMode: str = "vector",
                   batchSize: int = 64,
-                  inputSize: Optional[Tuple[int, int]] = None
-                  ) -> "TPUImageTransformer":
+                  inputSize: Optional[Tuple[int, int]] = None,
+                  mesh=None) -> "TPUImageTransformer":
         # outputMode validation lives in the param's typeConverter
         # (SparkDLTypeConverters.toOutputMode) so every set path is covered.
         return self._set(**self._input_kwargs)
@@ -103,6 +106,7 @@ class TPUImageTransformer(Transformer, HasInputCol, HasOutputCol,
         output_col = self.getOutputCol()
         mode = self.getOutputMode()
         batch_size = self.getBatchSize()
+        mesh = self.resolveMesh()
         target_size = self._target_size(model)
         run = model.flattened() if mode == "vector" else model
         if input_col not in dataset.columns:
@@ -119,7 +123,7 @@ class TPUImageTransformer(Transformer, HasInputCol, HasOutputCol,
             stacked = imageIO.imageStructsToBatchArray(
                 [structs[i] for i in valid], target_size=target_size,
                 dtype=model.input_spec.dtype)
-            out = run.apply_batch(stacked, batch_size=batch_size)
+            out = run.apply_batch(stacked, batch_size=batch_size, mesh=mesh)
             if mode == "vector":
                 return _vectors_with_nulls(out, valid, batch.num_rows)
             return _images_with_nulls(out, valid, batch.num_rows,

@@ -31,6 +31,7 @@ from sparkdl_tpu.param.converters import SparkDLTypeConverters, TypeConverters
 from sparkdl_tpu.param.shared_params import (
     HasBatchSize,
     HasInputCol,
+    HasMesh,
     HasOutputCol,
 )
 
@@ -38,7 +39,7 @@ SUPPORTED_MODELS = registry.SUPPORTED_MODEL_NAMES
 
 
 class _NamedImageTransformer(Transformer, HasInputCol, HasOutputCol,
-                             HasBatchSize):
+                             HasBatchSize, HasMesh):
     """Shared plumbing: modelName param + cached ModelFunction build."""
 
     modelName = Param(
@@ -106,7 +107,8 @@ class DeepImageFeaturizer(_NamedImageTransformer):
                  outputCol: Optional[str] = None,
                  modelName: Optional[str] = None,
                  weights="random",
-                 batchSize: int = 64) -> None:
+                 batchSize: int = 64,
+                 mesh=None) -> None:
         super().__init__()
         kwargs = self._input_kwargs
         self.setParams(**kwargs)
@@ -116,7 +118,8 @@ class DeepImageFeaturizer(_NamedImageTransformer):
                   outputCol: Optional[str] = None,
                   modelName: Optional[str] = None,
                   weights="random",
-                  batchSize: int = 64) -> "DeepImageFeaturizer":
+                  batchSize: int = 64,
+                  mesh=None) -> "DeepImageFeaturizer":
         return self._set(**self._input_kwargs)
 
     def _transform(self, dataset):
@@ -124,7 +127,7 @@ class DeepImageFeaturizer(_NamedImageTransformer):
         inner = TPUImageTransformer(
             inputCol=self.getInputCol(), outputCol=self.getOutputCol(),
             modelFunction=mf, outputMode="vector",
-            batchSize=self.getBatchSize())
+            batchSize=self.getBatchSize(), mesh=self.getMesh())
         return inner.transform(dataset)
 
 
@@ -147,7 +150,8 @@ class DeepImagePredictor(_NamedImageTransformer):
                  weights="random",
                  decodePredictions: bool = False,
                  topK: int = 5,
-                 batchSize: int = 64) -> None:
+                 batchSize: int = 64,
+                 mesh=None) -> None:
         super().__init__()
         self._setDefault(decodePredictions=False, topK=5)
         kwargs = self._input_kwargs
@@ -160,7 +164,8 @@ class DeepImagePredictor(_NamedImageTransformer):
                   weights="random",
                   decodePredictions: bool = False,
                   topK: int = 5,
-                  batchSize: int = 64) -> "DeepImagePredictor":
+                  batchSize: int = 64,
+                  mesh=None) -> "DeepImagePredictor":
         return self._set(**self._input_kwargs)
 
     def _transform(self, dataset):
@@ -171,7 +176,7 @@ class DeepImagePredictor(_NamedImageTransformer):
         inner = TPUImageTransformer(
             inputCol=self.getInputCol(), outputCol=raw_col,
             modelFunction=mf, outputMode="vector",
-            batchSize=self.getBatchSize())
+            batchSize=self.getBatchSize(), mesh=self.getMesh())
         frame = inner.transform(dataset)
         if not decode:
             return frame

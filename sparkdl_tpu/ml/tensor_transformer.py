@@ -20,6 +20,7 @@ from sparkdl_tpu.param.base import keyword_only
 from sparkdl_tpu.param.shared_params import (
     HasBatchSize,
     HasInputCol,
+    HasMesh,
     HasModelFunction,
     HasOutputCol,
 )
@@ -46,14 +47,15 @@ def column_to_block(column: pa.Array, element_shape) -> np.ndarray:
 
 
 class TPUTransformer(Transformer, HasInputCol, HasOutputCol,
-                     HasModelFunction, HasBatchSize):
+                     HasModelFunction, HasBatchSize, HasMesh):
     """Apply a ModelFunction to a numeric column, emitting list<float32>."""
 
     @keyword_only
     def __init__(self, *, inputCol: Optional[str] = None,
                  outputCol: Optional[str] = None,
                  modelFunction=None,
-                 batchSize: int = 64) -> None:
+                 batchSize: int = 64,
+                 mesh=None) -> None:
         super().__init__()
         self._setDefault(batchSize=64)
         kwargs = self._input_kwargs
@@ -63,7 +65,8 @@ class TPUTransformer(Transformer, HasInputCol, HasOutputCol,
     def setParams(self, *, inputCol: Optional[str] = None,
                   outputCol: Optional[str] = None,
                   modelFunction=None,
-                  batchSize: int = 64) -> "TPUTransformer":
+                  batchSize: int = 64,
+                  mesh=None) -> "TPUTransformer":
         return self._set(**self._input_kwargs)
 
     def _transform(self, dataset):
@@ -73,6 +76,7 @@ class TPUTransformer(Transformer, HasInputCol, HasOutputCol,
         input_col = self.getInputCol()
         output_col = self.getOutputCol()
         batch_size = self.getBatchSize()
+        mesh = self.resolveMesh()
         element_shape = model.input_spec.element_shape
         if input_col not in dataset.columns:
             raise KeyError(f"No such column: {input_col!r}")
@@ -83,7 +87,7 @@ class TPUTransformer(Transformer, HasInputCol, HasOutputCol,
             col = batch.column(batch.schema.get_field_index(input_col))
             block = column_to_block(col, element_shape)
             block = block.astype(model.input_spec.dtype, copy=False)
-            out = model.apply_batch(block, batch_size=batch_size)
+            out = model.apply_batch(block, batch_size=batch_size, mesh=mesh)
             out = np.asarray(out, dtype=np.float32).reshape(batch.num_rows, -1)
             return fixed_size_list_array(out).cast(pa.list_(pa.float32()))
 

@@ -101,8 +101,13 @@ def _pool(x, pool, strides, padding, kind: str):
     return summed / counts
 
 
-def _convert_layer(layer) -> Callable[[List[jnp.ndarray]], Callable]:
-    """Return fn(weights, *inputs) implementing ``layer`` at inference."""
+def _convert_layer(layer, input_rank=None) -> Callable[[List[jnp.ndarray]], Callable]:
+    """Return fn(weights, *inputs) implementing ``layer`` at inference.
+
+    ``input_rank``: rank of the layer's input tensor at this graph node
+    (layers can be shared across nodes, so rank is node context, not a
+    layer attribute).
+    """
     import keras
 
     cls = type(layer).__name__
@@ -176,12 +181,10 @@ def _convert_layer(layer) -> Callable[[List[jnp.ndarray]], Callable]:
         axis = layer.axis
         if isinstance(axis, (list, tuple)):
             axis = axis[0] if len(axis) == 1 else None
-        rank = None
-        try:
-            rank = len(layer.input.shape)
-        except Exception:  # noqa: BLE001 - layer outside a built graph
-            pass
-        if axis is None or (axis != -1 and (rank is None or axis != rank - 1)):
+        # legacy serializations store the last axis positively (e.g. 3 for
+        # NHWC); accept it whenever the node input rank confirms it is last
+        if axis is None or (axis != -1 and (input_rank is None
+                                            or axis != input_rank - 1)):
             raise ValueError(
                 f"Unsupported BatchNormalization axis {layer.axis!r} on layer "
                 f"{layer.name!r}; only the last (channel) axis is supported")
@@ -363,8 +366,10 @@ def _walk_graph(model):
     for depth, nodes in sorted(graph._nodes_by_depth.items(), reverse=True):
         for node in nodes:
             op = node.operation
-            fn = _convert_layer(op)
-            in_ids = [id(t) for t in node.input_tensors]
+            in_tensors = node.input_tensors
+            rank = len(in_tensors[0].shape) if in_tensors else None
+            fn = _convert_layer(op, input_rank=rank)
+            in_ids = [id(t) for t in in_tensors]
             out_ids = [id(t) for t in node.outputs]
             steps.append((op.name, fn, in_ids, out_ids))
     return (steps, [id(t) for t in graph.outputs],
@@ -383,41 +388,30 @@ def _run_steps(steps, env: Dict[int, Any], weights: Dict[str, List], out_ids):
     return [env[i] for i in out_ids]
 
 
-def _collect_weights(model) -> Dict[str, List[np.ndarray]]:
-    """{layer_name: [arrays]} for every weight-bearing layer, recursively."""
-    import keras
+def _collect_weights_and_mask(model):
+    """One traversal → ({layer_name: [arrays]}, {layer_name: [bools]}).
 
-    out: Dict[str, List[np.ndarray]] = {}
-    for layer in model.layers:
-        if isinstance(layer, keras.Model):
-            sub = _collect_weights(layer)
-            # nested models receive their whole dict as "weights"
-            out[layer.name] = sub  # type: ignore[assignment]
-        else:
-            ws = layer.get_weights()
-            if ws:
-                out[layer.name] = [np.asarray(w) for w in ws]
-    return out
-
-
-def _collect_trainable_mask(model) -> Dict[str, List[bool]]:
-    """Bool pytree matching :func:`_collect_weights`: True = trainable.
-
-    Keras marks e.g. BatchNorm ``moving_mean``/``moving_variance`` (and any
-    frozen layer's weights) non-trainable; the Trainer masks their updates
-    so fine-tuning cannot corrupt normalization statistics
-    (``layer.weights`` order is ``get_weights()`` order).
+    The two pytrees are leaf-for-leaf congruent BY CONSTRUCTION (one loop,
+    one inclusion condition) — ``optax.multi_transform`` requires exact
+    treedef match between params and the trainable mask. True = trainable;
+    keras marks e.g. BatchNorm ``moving_mean``/``moving_variance`` (and any
+    frozen layer's weights) non-trainable, and the Trainer freezes those so
+    fine-tuning cannot corrupt normalization statistics.
     """
     import keras
 
-    out: Dict[str, List[bool]] = {}
+    weights: Dict[str, List[np.ndarray]] = {}
+    mask: Dict[str, List[bool]] = {}
     for layer in model.layers:
         if isinstance(layer, keras.Model):
-            out[layer.name] = _collect_trainable_mask(layer)  # type: ignore[assignment]
-        else:
-            if layer.weights:
-                out[layer.name] = [bool(v.trainable) for v in layer.weights]
-    return out
+            # nested models receive their whole dict as "weights"
+            sub_w, sub_m = _collect_weights_and_mask(layer)
+            weights[layer.name] = sub_w  # type: ignore[assignment]
+            mask[layer.name] = sub_m  # type: ignore[assignment]
+        elif layer.weights:
+            weights[layer.name] = [np.asarray(v) for v in layer.weights]
+            mask[layer.name] = [bool(v.trainable) for v in layer.weights]
+    return weights, mask
 
 
 def keras_to_model_function(model, name: str = None) -> ModelFunction:
@@ -433,8 +427,7 @@ def keras_to_model_function(model, name: str = None) -> ModelFunction:
             f"Only single-output models supported, got {len(model.outputs)}")
 
     steps, out_ids, in_ids = _walk_graph(model)
-    weights = _collect_weights(model)
-    mask = _collect_trainable_mask(model)
+    weights, mask = _collect_weights_and_mask(model)
     in_shape = model.inputs[0].shape
     spec = TensorSpec(tuple(None if d is None else int(d) for d in in_shape),
                       "float32")

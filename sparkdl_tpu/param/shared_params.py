@@ -80,6 +80,43 @@ class HasBatchSize(Params):
         return self.getOrDefault(self.batchSize)
 
 
+class HasMesh(Params):
+    """Mixin: an optional ``jax.sharding.Mesh`` for multi-chip execution.
+
+    When unset, components fall back to the framework default mesh
+    (``sparkdl_tpu.core.mesh.set_default_mesh``) — the analog of the
+    reference's implicit "run on every executor" scale-out (SURVEY.md §3.1):
+    batches shard over the mesh's ``data`` axis, weights are replicated,
+    XLA emits the collectives over ICI/DCN.
+    """
+
+    mesh = Param(
+        "HasMesh", "mesh",
+        "optional jax.sharding.Mesh; batch shards over its 'data' axis. "
+        "None falls back to the framework default mesh (set_default_mesh)",
+        typeConverter=TypeConverters.identity)
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._setDefault(mesh=None)
+
+    def setMesh(self, value) -> "HasMesh":
+        if value is None:
+            self.clear(self.mesh)
+            return self
+        return self._set(mesh=value)
+
+    def getMesh(self):
+        return self.getOrDefault(self.mesh)
+
+    def resolveMesh(self):
+        """Explicit param if set, else the framework default mesh."""
+        from sparkdl_tpu.core.mesh import get_default_mesh
+
+        mesh = self.getOrDefault(self.mesh)
+        return mesh if mesh is not None else get_default_mesh()
+
+
 class HasModelFunction(Params):
     """The rebuild's analog of the reference's ``tfInputGraph``/Keras-model
     params: a :class:`sparkdl_tpu.core.model_function.ModelFunction`."""

@@ -80,15 +80,20 @@ def registerUDF(name: str, fn: Callable, outputType=None,
 
 
 def registerTensorUDF(name: str, modelFunction, batchSize: int = 64,
+                      mesh=None,
                       registry: Optional[UDFRegistry] = None) -> ColumnUDF:
-    """Register a ModelFunction over numeric columns under ``name``."""
+    """Register a ModelFunction over numeric columns under ``name``.
+
+    ``mesh``: optional jax.sharding.Mesh for multi-chip serving (falls back
+    to the framework default mesh when None).
+    """
 
     def apply_fn(df, input_col, output_col):
         from sparkdl_tpu.ml.tensor_transformer import TPUTransformer
 
         return TPUTransformer(inputCol=input_col, outputCol=output_col,
                               modelFunction=modelFunction,
-                              batchSize=batchSize).transform(df)
+                              batchSize=batchSize, mesh=mesh).transform(df)
 
     return (registry or udf_registry).register(
         ColumnUDF(name, apply_fn, "tensor_model"))
@@ -96,12 +101,15 @@ def registerTensorUDF(name: str, modelFunction, batchSize: int = 64,
 
 def registerImageUDF(name: str, modelFunction, batchSize: int = 64,
                      preprocessor: Optional[Callable] = None,
+                     mesh=None,
                      registry: Optional[UDFRegistry] = None) -> ColumnUDF:
     """Register a ModelFunction over image-struct columns under ``name``.
 
     ``preprocessor`` (optional): host-side ``HWC ndarray -> HWC ndarray``
     applied per image before staging — the analog of the reference's
     preprocessor graph piece composed in front of the model (§3.4).
+    ``mesh``: optional jax.sharding.Mesh for multi-chip serving (falls back
+    to the framework default mesh when None).
     """
 
     def apply_fn(df, input_col, output_col):
@@ -126,7 +134,7 @@ def registerImageUDF(name: str, modelFunction, batchSize: int = 64,
         out = TPUImageTransformer(
             inputCol=model_input, outputCol=output_col,
             modelFunction=modelFunction, outputMode="vector",
-            batchSize=batchSize).transform(frame)
+            batchSize=batchSize, mesh=mesh).transform(frame)
         if model_input != input_col:
             out = out.drop(model_input)
         return out
@@ -138,6 +146,7 @@ def registerImageUDF(name: str, modelFunction, batchSize: int = 64,
 def registerKerasImageUDF(udfName: str, kerasModelOrFile: Any,
                           preprocessor: Optional[Callable] = None,
                           batchSize: int = 64,
+                          mesh=None,
                           registry: Optional[UDFRegistry] = None) -> ColumnUDF:
     """Keras model (object or .h5/.keras path) as a named image UDF.
 
@@ -155,4 +164,5 @@ def registerKerasImageUDF(udfName: str, kerasModelOrFile: Any,
         keras_model = kerasModelOrFile
     mf = keras_to_model_function(keras_model, name=udfName)
     return registerImageUDF(udfName, mf, batchSize=batchSize,
-                            preprocessor=preprocessor, registry=registry)
+                            preprocessor=preprocessor, mesh=mesh,
+                            registry=registry)

@@ -1,0 +1,148 @@
+"""Multi-chip data-parallel INFERENCE through the user-facing API.
+
+The reference's core scale-out path is featurize/predict over all
+executors (SURVEY.md §3.1); the rebuild's analog is the mesh ``data``
+axis. These tests assert, on the virtual 8-device CPU mesh, that every
+user-facing surface (named transformers, generic transformers, UDFs,
+fitted estimator models) produces IDENTICAL output sharded vs
+single-device — the equality criterion VERDICT r1 set for this feature.
+"""
+
+import numpy as np
+import pytest
+
+from sparkdl_tpu.core.mesh import (
+    MeshConfig,
+    get_default_mesh,
+    make_mesh,
+    set_default_mesh,
+    use_mesh,
+)
+from sparkdl_tpu.engine.dataframe import DataFrame
+from sparkdl_tpu.image import imageIO
+from sparkdl_tpu.ml import DeepImageFeaturizer, TPUImageTransformer, TPUTransformer
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    return make_mesh(MeshConfig(data=8))
+
+
+@pytest.fixture
+def image_df(rng):
+    rows = []
+    for i in range(13):  # deliberately not a multiple of 8
+        arr = rng.integers(0, 255, size=(32, 32, 3), dtype=np.uint8)
+        rows.append({"image": imageIO.imageArrayToStruct(arr, origin=str(i)),
+                     "idx": i})
+    schema = None
+    import pyarrow as pa
+
+    schema = pa.schema([pa.field("image", imageIO.imageSchema),
+                        pa.field("idx", pa.int64())])
+    return DataFrame.fromRows(rows, schema=schema, numPartitions=3)
+
+
+def _featurize(df, mesh):
+    t = DeepImageFeaturizer(inputCol="image", outputCol="features",
+                            modelName="TestNet", batchSize=8, mesh=mesh)
+    out = t.transform(df).collect()
+    return np.stack([np.asarray(r["features"]) for r in out])
+
+
+def test_featurizer_mesh_matches_single_device(image_df, mesh8):
+    single = _featurize(image_df, None)
+    sharded = _featurize(image_df, mesh8)
+    np.testing.assert_allclose(sharded, single, rtol=1e-6, atol=1e-6)
+    assert single.shape[0] == 13
+
+
+def test_tensor_transformer_mesh_matches_single_device(rng, mesh8):
+    from sparkdl_tpu.core.model_function import ModelFunction, TensorSpec
+
+    w = rng.normal(size=(6, 4)).astype(np.float32)
+    mf = ModelFunction.fromFunction(
+        lambda vs, x: np.tanh(1.0) * (x @ vs["w"]), {"w": w},
+        TensorSpec((None, 6)))
+    x = rng.normal(size=(11, 6)).astype(np.float32)
+    df = DataFrame.fromColumns({"x": x}, numPartitions=2)
+
+    def run(mesh):
+        t = TPUTransformer(inputCol="x", outputCol="y", modelFunction=mf,
+                           batchSize=4, mesh=mesh)
+        out = t.transform(df).collect()
+        return np.stack([np.asarray(r["y"]) for r in out])
+
+    np.testing.assert_allclose(run(mesh8), run(None), rtol=1e-6, atol=1e-6)
+
+
+def test_default_mesh_fallback(image_df, mesh8):
+    """set_default_mesh makes every transformer multi-chip without params."""
+    single = _featurize(image_df, None)
+    assert get_default_mesh() is None
+    try:
+        set_default_mesh(mesh8)
+        sharded = _featurize(image_df, None)
+    finally:
+        set_default_mesh(None)
+    np.testing.assert_allclose(sharded, single, rtol=1e-6, atol=1e-6)
+
+
+def test_use_mesh_context_manager(image_df, mesh8):
+    single = _featurize(image_df, None)
+    with use_mesh(mesh8):
+        sharded = _featurize(image_df, None)
+    assert get_default_mesh() is None
+    np.testing.assert_allclose(sharded, single, rtol=1e-6, atol=1e-6)
+
+
+def test_udf_serving_mesh_matches_single_device(image_df, mesh8):
+    from sparkdl_tpu.models import registry
+    from sparkdl_tpu.udf import registerImageUDF, udf_registry
+
+    mf = registry.build_featurizer("TestNet")
+    try:
+        registerImageUDF("mesh_feat", mf, batchSize=8, mesh=mesh8)
+        registerImageUDF("plain_feat", mf, batchSize=8)
+        sharded = image_df.selectExpr("mesh_feat(image) as f").collect()
+        single = image_df.selectExpr("plain_feat(image) as f").collect()
+    finally:
+        udf_registry.unregister("mesh_feat")
+        udf_registry.unregister("plain_feat")
+    a = np.stack([np.asarray(r["f"]) for r in sharded])
+    b = np.stack([np.asarray(r["f"]) for r in single])
+    np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6)
+
+
+def test_estimator_mesh_trained_model_transforms_on_mesh(tmp_path, mesh8):
+    """Fitted model inherits the estimator's mesh and transforms correctly."""
+    keras = pytest.importorskip("keras")
+    from keras import layers
+    from PIL import Image
+
+    from sparkdl_tpu.ml import KerasImageFileEstimator
+
+    rng = np.random.default_rng(0)
+    rows = []
+    for i in range(16):
+        label = i % 2
+        arr = rng.integers(0, 40, size=(8, 8, 3), dtype=np.uint8)
+        arr[..., label] += 180
+        p = tmp_path / f"img_{i}.png"
+        Image.fromarray(arr).save(p)
+        rows.append({"uri": str(p), "label": label})
+    df = DataFrame.fromRows(rows, numPartitions=2)
+    m = keras.Sequential([
+        keras.Input((8, 8, 3)), layers.Rescaling(1 / 255.0),
+        layers.Flatten(), layers.Dense(2, activation="softmax")])
+    est = KerasImageFileEstimator(
+        inputCol="uri", outputCol="preds", labelCol="label", model=m,
+        mesh=mesh8,
+        kerasFitParams={"epochs": 25, "batch_size": 8,
+                        "learning_rate": 0.05})
+    fitted = est.fit(df)
+    assert fitted.getMesh() is mesh8
+    out = fitted.transform(df).collect()
+    preds = np.array([np.argmax(r["preds"]) for r in out])
+    labels = np.array([r["label"] for r in out])
+    assert (preds == labels).mean() >= 0.9

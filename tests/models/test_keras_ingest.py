@@ -160,3 +160,14 @@ def test_finetune_does_not_corrupt_bn_moving_stats(np_rng):
     np.testing.assert_array_equal(after[2], before[2])
     np.testing.assert_array_equal(after[3], before[3])
     assert not np.allclose(after[0], before[0])
+
+
+def test_shared_bn_with_positive_axis(np_rng):
+    """A BN instance shared across two nodes with axis stored positively
+    (legacy .h5 style) is the supported last-axis case — must ingest."""
+    inp = keras.Input((6, 6, 3))
+    bn = layers.BatchNormalization(axis=3)
+    out = layers.Add()([bn(inp), bn(layers.Conv2D(3, 1)(inp))])
+    m = keras.Model(inp, out)
+    x = np_rng.normal(size=(2, 6, 6, 3)).astype(np.float32)
+    _check(m, x)
