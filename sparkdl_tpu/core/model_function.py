@@ -73,6 +73,7 @@ class ModelFunction:
         # Trainer masks their updates. None = everything trainable.
         self.trainable_mask = trainable_mask
         self._jit_cache: Dict[Tuple, Callable] = {}
+        self._flat_cache: Optional["ModelFunction"] = None
 
     # -- construction matrix (TFInputGraph parity) ---------------------------
 
@@ -224,24 +225,44 @@ class ModelFunction:
                              trainable_mask=self.trainable_mask)
 
     def flattened(self) -> "ModelFunction":
-        """Flatten outputs to (batch, -1) — the ``buildFlattener`` analog."""
-        return self.with_postprocess(lambda y: y.reshape(y.shape[0], -1))
+        """Flatten outputs to (batch, -1) — the ``buildFlattener`` analog.
+
+        Memoized: callers invoke this per transform() call, and a fresh
+        ModelFunction would mean a fresh jit cache — i.e. a full XLA
+        recompile of the model on EVERY transform (measured ~13s/call over
+        the remote PJRT tunnel).
+        """
+        if self._flat_cache is None:
+            self._flat_cache = self.with_postprocess(
+                lambda y: y.reshape(y.shape[0], -1))
+        return self._flat_cache
 
     # -- execution -----------------------------------------------------------
 
     def jitted(self, mesh=None, donate_batch: bool = False) -> Callable:
         """Compiled ``batch -> output`` closure over the variables.
 
-        With a mesh, inputs are sharded batch-wise over ``data`` and
-        variables are replicated — XLA lays collectives over ICI as needed.
-        Cache key: (mesh, donate) — shape specialization is jit's own cache.
+        The traced program casts the input to the spec dtype FIRST (a no-op
+        when it already matches), so batches can stage in uint8 — 4x fewer
+        host→HBM DMA bytes than float32 — with normalize/preprocess fused
+        after the on-device cast. With a mesh, inputs are sharded batch-wise
+        over ``data`` and variables are replicated — XLA lays collectives
+        over ICI as needed. Cache key: (mesh, donate) — shape/dtype
+        specialization is jit's own cache.
         """
         key = (id(mesh) if mesh is not None else None, donate_batch)
         cached = self._jit_cache.get(key)
         if cached is not None:
             return cached
 
-        apply_fn = self.apply_fn
+        dtype = jnp.dtype(self.input_spec.dtype)
+        inner_apply = self.apply_fn
+
+        def apply_fn(vs, x):
+            if x.dtype != dtype:
+                x = x.astype(dtype)
+            return inner_apply(vs, x)
+
         if mesh is None:
             variables = self.variables
             kwargs: Dict[str, Any] = {"donate_argnums": (1,)} if donate_batch else {}
@@ -258,14 +279,23 @@ class ModelFunction:
 
     def apply_batch(self, array: np.ndarray, batch_size: int = 64,
                     mesh=None) -> np.ndarray:
-        """Run over N rows with fixed-shape padded chunks; returns numpy."""
-        array = np.asarray(array, dtype=self.input_spec.dtype)
+        """Run over N rows with fixed-shape padded chunks; returns numpy.
+
+        uint8 input stages as uint8 (the jitted program casts on device —
+        quarter the transfer bytes); anything else is cast host-side to the
+        spec dtype.
+        """
+        array = np.asarray(array)
+        if array.dtype != np.uint8 and array.dtype != np.dtype(self.input_spec.dtype):
+            array = array.astype(self.input_spec.dtype)
         fn = self.jitted(mesh=mesh)
+        multiple = 1
         if mesh is not None:
             # pad batch_size so every data-axis shard is equal
             from sparkdl_tpu.core.mesh import data_axis_size, pad_to_multiple
-            batch_size = pad_to_multiple(batch_size, data_axis_size(mesh))
-        return batching.run_batched(fn, array, batch_size)
+            multiple = data_axis_size(mesh)
+            batch_size = pad_to_multiple(batch_size, multiple)
+        return batching.run_batched(fn, array, batch_size, multiple=multiple)
 
     def __call__(self, x) -> jax.Array:
         return self.apply_fn(self.variables, x)

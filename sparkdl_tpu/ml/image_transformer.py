@@ -120,9 +120,11 @@ class TPUImageTransformer(Transformer, HasInputCol, HasOutputCol,
                 out_type = (pa.list_(pa.float32()) if mode == "vector"
                             else imageIO.imageSchema)
                 return pa.array([None] * batch.num_rows, type=out_type)
+            # dtype=None: uint8 images stage as uint8 (4x fewer DMA bytes);
+            # the jitted program casts to the spec dtype on device.
             stacked = imageIO.imageStructsToBatchArray(
                 [structs[i] for i in valid], target_size=target_size,
-                dtype=model.input_spec.dtype)
+                dtype=None)
             out = run.apply_batch(stacked, batch_size=batch_size, mesh=mesh)
             if mode == "vector":
                 return _vectors_with_nulls(out, valid, batch.num_rows)

@@ -116,8 +116,12 @@ def _resolve_variables(spec: ModelSpec, module, weights, seed: int,
     """Resolve the ``weights`` argument to a Flax variables pytree."""
     if weights is None or weights == "random":
         rng = jax.random.PRNGKey(seed)
-        return module.init(rng, jnp.zeros(input_spec.with_batch(1),
-                                          dtype=input_spec.dtype))
+        # jit the init: eager init dispatches one RPC per op, which is
+        # pathological over a remote PJRT tunnel (measured 278s for
+        # InceptionV3 eager vs seconds jitted — one compiled program).
+        init = jax.jit(module.init)
+        return init(rng, jnp.zeros(input_spec.with_batch(1),
+                                   dtype=input_spec.dtype))
     if isinstance(weights, dict):
         return weights
     if isinstance(weights, str):

@@ -182,10 +182,30 @@ class CanLoadImage(Params):
 
         Runs host-side, partition-parallel (the reference ran it as a Spark
         Python-worker UDF; here it is an engine map over Arrow partitions).
+        Default path with a known target size: the WHOLE partition decodes
+        in one call into the threaded C++ batch decoder (GIL released,
+        PIL fallback per failing image) — the hot-path fix for SURVEY.md §7
+        hard-part #2. A custom ``imageLoader`` keeps per-row semantics.
         """
         from sparkdl_tpu.image import imageIO  # lazy: avoid import cycle
 
         loader = self.getOrDefault(self.imageLoader)
+
+        if loader is None and target_size is not None:
+            import pyarrow as pa
+
+            def load_partition(batch: "pa.RecordBatch") -> "pa.Array":
+                idx = batch.schema.get_field_index(inputCol)
+                uris = batch.column(idx).to_pylist()
+                arrays = imageIO.decodeImageFilesBatch(uris, target_size)
+                values = [
+                    imageIO.imageArrayToStruct(a, origin=u or "")
+                    if a is not None else None
+                    for a, u in zip(arrays, uris)]
+                return pa.array(values, type=imageIO.imageSchema)
+
+            return dataframe.withColumnBatch(
+                outputCol, load_partition, outputType=imageIO.imageSchema)
 
         def load_one(uri: str):
             if loader is not None:

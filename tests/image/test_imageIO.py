@@ -108,3 +108,80 @@ def test_read_images_decode_is_lazy_and_parallel(tiny_image_dir):
     df = imageIO.readImages(str(tiny_image_dir))
     assert df._materialized is None  # plan only
     assert df.count() == 5
+
+
+def _write_fixtures(tmp_path, rng):
+    from PIL import Image
+
+    paths = []
+    for i in range(4):
+        arr = rng.integers(0, 255, size=(20 + 4 * i, 24, 3), dtype=np.uint8)
+        p = tmp_path / f"b{i}.jpg"
+        Image.fromarray(arr).save(p, quality=95)
+        paths.append(str(p))
+    p = tmp_path / "b_png.png"
+    Image.fromarray(rng.integers(0, 255, size=(16, 16, 3),
+                                 dtype=np.uint8)).save(p)
+    paths.append(str(p))
+    return paths
+
+
+def test_decode_files_batch_matches_per_image(tmp_path, rng):
+    """The partition batch-decode hot path must agree with the per-image
+    decoder, and handle corrupt/missing/None URIs as null rows."""
+    paths = _write_fixtures(tmp_path, rng)
+    bad = tmp_path / "corrupt.jpg"
+    bad.write_bytes(b"definitely not a jpeg")
+    uris = paths + [str(bad), str(tmp_path / "missing.jpg"), None]
+    out = imageIO.decodeImageFilesBatch(uris, target_size=(18, 18))
+    assert len(out) == len(uris)
+    assert out[-1] is None and out[-2] is None and out[-3] is None
+    for uri, arr in zip(paths, out):
+        assert arr is not None and arr.shape == (18, 18, 3)
+        assert arr.dtype == np.uint8
+        single = imageIO.decodeImageFile(uri, target_size=(18, 18))
+        # same decoder family → same pixels (PIL fallback may differ by
+        # resize rounding, tolerate 2 LSB)
+        assert np.abs(arr.astype(int) - single.astype(int)).max() <= 2
+
+
+def test_decode_bytes_batch_pil_fallback(tmp_path, rng, monkeypatch):
+    """With the native library unavailable the batch path must still decode
+    every blob (PIL, forced RGB)."""
+    from PIL import Image
+
+    from sparkdl_tpu.native import loader as native_loader
+
+    monkeypatch.setattr(native_loader, "decode_batch_status",
+                        lambda *a, **k: None)
+    blobs = []
+    for i in range(2):
+        import io
+
+        buf = io.BytesIO()
+        Image.fromarray(rng.integers(0, 255, size=(12, 12, 3),
+                                     dtype=np.uint8)).save(buf, format="PNG")
+        blobs.append(buf.getvalue())
+    # grayscale must come out 3-channel like the native path
+    import io
+
+    buf = io.BytesIO()
+    Image.fromarray(rng.integers(0, 255, size=(12, 12),
+                                 dtype=np.uint8)).save(buf, format="PNG")
+    blobs.append(buf.getvalue())
+    out = imageIO.decodeImageBytesBatch(blobs, target_size=(10, 10))
+    assert all(a is not None and a.shape == (10, 10, 3) for a in out)
+
+
+def test_struct_batch_array_preserves_uint8(rng):
+    arrs = [rng.integers(0, 255, size=(8, 8, 3), dtype=np.uint8)
+            for _ in range(3)]
+    structs = [imageIO.imageArrayToStruct(a) for a in arrs]
+    batch = imageIO.imageStructsToBatchArray(structs, dtype=None)
+    assert batch.dtype == np.uint8
+    np.testing.assert_array_equal(batch, np.stack(arrs))
+    # mixed dtypes promote to float32
+    structs.append(imageIO.imageArrayToStruct(
+        rng.normal(size=(8, 8, 3)).astype(np.float32)))
+    mixed = imageIO.imageStructsToBatchArray(structs, dtype=None)
+    assert mixed.dtype == np.float32

@@ -154,3 +154,27 @@ def test_fit_binary_head_scalar_labels(labeled_image_df):
     preds = np.array([float(r["preds"][0]) >= 0.5 for r in out])
     labels = np.array([r["label"] for r in out])
     assert (preds == labels).mean() >= 0.9
+
+
+def test_load_images_internal_batch_equals_per_row(labeled_image_df):
+    """Default (native batch) decode path must agree with the per-row
+    custom-loader path on every row."""
+    from sparkdl_tpu.image import imageIO
+
+    est = KerasImageFileEstimator(
+        inputCol="uri", outputCol="p", labelCol="label", model=_tiny_cnn())
+    batch_df = est.loadImagesInternal(labeled_image_df, "uri", "img",
+                                      target_size=(8, 8))
+    per_row = KerasImageFileEstimator(
+        inputCol="uri", outputCol="p", labelCol="label", model=_tiny_cnn(),
+        imageLoader=lambda uri: imageIO.decodeImageFile(uri,
+                                                        target_size=(8, 8)))
+    row_df = per_row.loadImagesInternal(labeled_image_df, "uri", "img",
+                                        target_size=(8, 8))
+    a = [r["img"] for r in batch_df.collect()]
+    b = [r["img"] for r in row_df.collect()]
+    assert len(a) == len(b) == 24
+    for sa, sb in zip(a, b):
+        xa = imageIO.imageStructToArray(sa).astype(int)
+        xb = imageIO.imageStructToArray(sb).astype(int)
+        assert np.abs(xa - xb).max() <= 2  # decoder-family rounding only
