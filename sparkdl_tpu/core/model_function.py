@@ -60,6 +60,10 @@ class ModelFunction:
     frozen exported artifacts whose weights are baked in).
     """
 
+    # True when the registry selected an inference-specialized fast apply
+    # (models/*_fast.py); set post-construction by the registry builders.
+    fast_path = False
+
     def __init__(self, apply_fn: Callable[[Any, jax.Array], jax.Array],
                  variables: Any, input_spec: TensorSpec,
                  name: str = "model",

@@ -22,6 +22,7 @@ from typing import Optional, Tuple
 import numpy as np
 import pyarrow as pa
 
+from sparkdl_tpu.core import profiling
 from sparkdl_tpu.engine.dataframe import fixed_size_list_array
 from sparkdl_tpu.image import imageIO
 from sparkdl_tpu.ml.base import Transformer
@@ -122,10 +123,13 @@ class TPUImageTransformer(Transformer, HasInputCol, HasOutputCol,
                 return pa.array([None] * batch.num_rows, type=out_type)
             # dtype=None: uint8 images stage as uint8 (4x fewer DMA bytes);
             # the jitted program casts to the spec dtype on device.
-            stacked = imageIO.imageStructsToBatchArray(
-                [structs[i] for i in valid], target_size=target_size,
-                dtype=None)
-            out = run.apply_batch(stacked, batch_size=batch_size, mesh=mesh)
+            with profiling.annotate("sparkdl.host_stage"):
+                stacked = imageIO.imageStructsToBatchArray(
+                    [structs[i] for i in valid], target_size=target_size,
+                    dtype=None)
+            with profiling.annotate("sparkdl.device_apply"):
+                out = run.apply_batch(stacked, batch_size=batch_size,
+                                      mesh=mesh)
             if mode == "vector":
                 return _vectors_with_nulls(out, valid, batch.num_rows)
             return _images_with_nulls(out, valid, batch.num_rows,

@@ -52,10 +52,15 @@ class _NamedImageTransformer(Transformer, HasInputCol, HasOutputCol,
         "weight source: 'random' (seeded init), a Flax variables dict, a "
         "Keras model/.h5/.keras file, a msgpack file, or an Orbax dir",
         typeConverter=TypeConverters.identity)
+    dtype = Param(
+        "_NamedImageTransformer", "dtype",
+        "compute dtype on device (e.g. jnp.bfloat16 for the MXU fast path); "
+        "None computes in float32",
+        typeConverter=TypeConverters.identity)
 
     def __init__(self) -> None:
         super().__init__()
-        self._setDefault(batchSize=64, weights="random")
+        self._setDefault(batchSize=64, weights="random", dtype=None)
         self._mf_cache = {}
 
     def setModelName(self, value: str):
@@ -70,13 +75,20 @@ class _NamedImageTransformer(Transformer, HasInputCol, HasOutputCol,
     def getWeights(self):
         return self.getOrDefault(self.weights)
 
+    def setDtype(self, value):
+        return self._set(dtype=value)
+
+    def getDtype(self):
+        return self.getOrDefault(self.dtype)
+
     def _model_function(self, kind: str):
         name = self.getModelName()
         weights = self.getWeights()
-        # Cache keyed by (kind, name) and validated against the exact weights
-        # object/path — bounded size, and a new weights value (even one
-        # reusing a freed object's address) can never hit a stale entry.
-        key = (kind, name)
+        dtype = self.getDtype()
+        # Cache keyed by (kind, name, dtype) and validated against the exact
+        # weights object/path — bounded size, and a new weights value (even
+        # one reusing a freed object's address) can never hit a stale entry.
+        key = (kind, name, str(dtype))
         cached = self._mf_cache.get(key)
         if cached is not None:
             cached_weights, mf = cached
@@ -85,7 +97,7 @@ class _NamedImageTransformer(Transformer, HasInputCol, HasOutputCol,
                 return mf
         build = (registry.build_featurizer if kind == "featurize"
                  else registry.build_predictor)
-        mf = build(name, weights=weights)
+        mf = build(name, weights=weights, dtype=dtype)
         self._mf_cache[key] = (weights, mf)
         return mf
 
@@ -108,6 +120,7 @@ class DeepImageFeaturizer(_NamedImageTransformer):
                  modelName: Optional[str] = None,
                  weights="random",
                  batchSize: int = 64,
+                 dtype=None,
                  mesh=None) -> None:
         super().__init__()
         kwargs = self._input_kwargs
@@ -119,6 +132,7 @@ class DeepImageFeaturizer(_NamedImageTransformer):
                   modelName: Optional[str] = None,
                   weights="random",
                   batchSize: int = 64,
+                  dtype=None,
                   mesh=None) -> "DeepImageFeaturizer":
         return self._set(**self._input_kwargs)
 
@@ -151,6 +165,7 @@ class DeepImagePredictor(_NamedImageTransformer):
                  decodePredictions: bool = False,
                  topK: int = 5,
                  batchSize: int = 64,
+                 dtype=None,
                  mesh=None) -> None:
         super().__init__()
         self._setDefault(decodePredictions=False, topK=5)
@@ -165,6 +180,7 @@ class DeepImagePredictor(_NamedImageTransformer):
                   decodePredictions: bool = False,
                   topK: int = 5,
                   batchSize: int = 64,
+                  dtype=None,
                   mesh=None) -> "DeepImagePredictor":
         return self._set(**self._input_kwargs)
 

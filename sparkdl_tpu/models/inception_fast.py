@@ -1,0 +1,178 @@
+"""Fused inference fast path for InceptionV3.
+
+``models/inception.py`` is the *definitional* implementation (Flax module,
+keras.applications parity, used for training and weight conversion). This
+module is a hand-written JAX apply over the SAME variables tree, optimized
+for TPU inference:
+
+- **BN folding**: at inference BatchNorm is an affine map, so it folds into
+  the (bias-free) conv as ``k' = k * rsqrt(var+eps)``, ``b' = bias -
+  mean * rsqrt(var+eps)`` — the conv epilogue becomes one bias-add + ReLU.
+- **Branch fusion**: the parallel 1x1 convs at the head of every inception
+  block consume the same input, so ``concat_F(conv_1, conv_2, conv_3)`` is
+  rewritten as ONE conv with kernels concatenated along the output-channel
+  axis. Each output channel's math is unchanged (bitwise, per channel, up
+  to float reassociation); the MXU sees 176-1152 output lanes instead of
+  three 48-448 passes, and the block input is read from HBM once instead
+  of three times.
+
+Parity with the module is asserted by ``tests/models/test_inception_fast.py``
+(f32 CPU equality) and the call order mirrors ``inception.py`` cb-index for
+cb-index — any architecture drift fails the test.
+
+Reference parity note: the reference ran frozen TF graphs through
+grappler's constant-folding/fusion (SURVEY.md §2.1 graph utils); this is
+the TPU-native analog — an inference-specialized program over identical
+weights.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sparkdl_tpu.models.layers import (
+    KERAS_BN_EPS, avg_pool_same, global_avg_pool, max_pool,
+)
+
+_DIMS = ("NHWC", "HWIO", "NHWC")
+
+
+def _folded(variables: Any, idx: int, compute_dtype) -> Tuple[jax.Array, jax.Array]:
+    """BN-folded (kernel, bias) for ConvBN unit ``cb{idx}``.
+
+    Folding runs in f32 on weight-sized tensors (negligible next to the
+    conv) and casts once to the compute dtype.
+    """
+    p = variables["params"][f"cb{idx}"]
+    s = variables["batch_stats"][f"cb{idx}"]["bn"]
+    k = jnp.asarray(p["conv"]["kernel"], jnp.float32)
+    bias = jnp.asarray(p["bn"]["bias"], jnp.float32)
+    scale = p["bn"].get("scale")
+    inv = jax.lax.rsqrt(jnp.asarray(s["var"], jnp.float32) + KERAS_BN_EPS)
+    if scale is not None:
+        inv = inv * jnp.asarray(scale, jnp.float32)
+    kf = k * inv  # [kh,kw,cin,F] * [F]
+    bf = bias - jnp.asarray(s["mean"], jnp.float32) * inv
+    return kf.astype(compute_dtype), bf.astype(compute_dtype)
+
+
+def _conv(x, kernel, bias, strides=(1, 1), padding="SAME", relu=True):
+    y = jax.lax.conv_general_dilated(
+        x, kernel, window_strides=strides, padding=padding,
+        dimension_numbers=_DIMS)
+    y = y + bias
+    return jax.nn.relu(y) if relu else y
+
+
+def _cb(variables, x, idx, strides=(1, 1), padding="SAME"):
+    k, b = _folded(variables, idx, x.dtype)
+    return _conv(x, k, b, strides, padding)
+
+
+def _cb_fused(variables, x, idxs: Sequence[int]) -> Tuple[jax.Array, ...]:
+    """The parallel 1x1 ConvBN heads ``idxs`` as ONE conv; returns splits."""
+    folded = [_folded(variables, i, x.dtype) for i in idxs]
+    k = jnp.concatenate([f[0] for f in folded], axis=3)
+    b = jnp.concatenate([f[1] for f in folded], axis=0)
+    y = _conv(x, k, b)
+    sizes = [f[0].shape[3] for f in folded]
+    outs, off = [], 0
+    for n in sizes:
+        outs.append(y[..., off:off + n])
+        off += n
+    return tuple(outs)
+
+
+def inception_v3_fast_apply(variables: Any, x: jax.Array,
+                            include_top: bool = False,
+                            pooling: Optional[str] = "avg",
+                            compute_dtype=jnp.bfloat16) -> jax.Array:
+    """Inference-only InceptionV3 forward over the standard variables tree.
+
+    Call order mirrors ``models/inception.py`` exactly (cb0..cb93); see
+    module docstring for the fusion rules applied.
+    """
+    x = x.astype(compute_dtype)
+
+    # Stem
+    x = _cb(variables, x, 0, strides=(2, 2), padding="VALID")
+    x = _cb(variables, x, 1, padding="VALID")
+    x = _cb(variables, x, 2)
+    x = max_pool(x, 3, 2)
+    x = _cb(variables, x, 3, padding="VALID")
+    x = _cb(variables, x, 4, padding="VALID")
+    x = max_pool(x, 3, 2)
+
+    # mixed 0..2: 35x35 inception-A
+    idx = 5
+    for _ in range(3):
+        b1, b5, b3 = _cb_fused(variables, x, (idx, idx + 1, idx + 3))
+        b5 = _cb(variables, b5, idx + 2)                    # 5x5
+        b3 = _cb(variables, b3, idx + 4)
+        b3 = _cb(variables, b3, idx + 5)
+        bp = avg_pool_same(x)
+        bp = _cb(variables, bp, idx + 6)
+        x = jnp.concatenate([b1, b5, b3, bp], axis=-1)
+        idx += 7
+
+    # mixed 3: reduction (idx == 26)
+    b3 = _cb(variables, x, idx, strides=(2, 2), padding="VALID")
+    bd = _cb(variables, x, idx + 1)
+    bd = _cb(variables, bd, idx + 2)
+    bd = _cb(variables, bd, idx + 3, strides=(2, 2), padding="VALID")
+    bp = max_pool(x, 3, 2)
+    x = jnp.concatenate([b3, bd, bp], axis=-1)
+    idx += 4
+
+    # mixed 4..7: 17x17 inception-B (idx == 30)
+    for _ in range(4):
+        b1, b7, bd = _cb_fused(variables, x, (idx, idx + 1, idx + 4))
+        b7 = _cb(variables, b7, idx + 2)                    # 1x7
+        b7 = _cb(variables, b7, idx + 3)                    # 7x1
+        bd = _cb(variables, bd, idx + 5)                    # 7x1
+        bd = _cb(variables, bd, idx + 6)                    # 1x7
+        bd = _cb(variables, bd, idx + 7)                    # 7x1
+        bd = _cb(variables, bd, idx + 8)                    # 1x7
+        bp = avg_pool_same(x)
+        bp = _cb(variables, bp, idx + 9)
+        x = jnp.concatenate([b1, b7, bd, bp], axis=-1)
+        idx += 10
+
+    # mixed 8: reduction (idx == 70)
+    b3, b7 = _cb_fused(variables, x, (idx, idx + 2))
+    b3 = _cb(variables, b3, idx + 1, strides=(2, 2), padding="VALID")
+    b7 = _cb(variables, b7, idx + 3)                        # 1x7
+    b7 = _cb(variables, b7, idx + 4)                        # 7x1
+    b7 = _cb(variables, b7, idx + 5, strides=(2, 2), padding="VALID")
+    bp = max_pool(x, 3, 2)
+    x = jnp.concatenate([b3, b7, bp], axis=-1)
+    idx += 6
+
+    # mixed 9..10: 8x8 inception-C (idx == 76)
+    for _ in range(2):
+        b1, b3, bd = _cb_fused(variables, x, (idx, idx + 1, idx + 4))
+        b3a = _cb(variables, b3, idx + 2)                   # 1x3
+        b3b = _cb(variables, b3, idx + 3)                   # 3x1
+        b3 = jnp.concatenate([b3a, b3b], axis=-1)
+        bd = _cb(variables, bd, idx + 5)                    # 3x3
+        bda = _cb(variables, bd, idx + 6)                   # 1x3
+        bdb = _cb(variables, bd, idx + 7)                   # 3x1
+        bd = jnp.concatenate([bda, bdb], axis=-1)
+        bp = avg_pool_same(x)
+        bp = _cb(variables, bp, idx + 8)
+        x = jnp.concatenate([b1, b3, bd, bp], axis=-1)
+        idx += 9
+
+    if include_top:
+        x = global_avg_pool(x)
+        p = variables["params"]["predictions"]
+        logits = x @ p["kernel"].astype(x.dtype) + p["bias"].astype(x.dtype)
+        return jax.nn.softmax(logits)
+    if pooling == "avg":
+        return global_avg_pool(x)
+    if pooling == "max":
+        return jnp.max(x, axis=(1, 2))
+    return x

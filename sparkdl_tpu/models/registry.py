@@ -160,12 +160,36 @@ def _spec_input(spec: ModelSpec) -> TensorSpec:
     return TensorSpec((None, h, w, 3), "float32")
 
 
+def _fast_inference_apply(name: str, include_top: bool, dtype):
+    """Inference-specialized apply for models that have one, else None.
+
+    InceptionV3 has a fused fast path (BN folding + branch-fused 1x1 convs,
+    ``models/inception_fast.py``) measured ~13% faster than the module
+    apply on TPU (r3 profile: 9.4k vs 7.5k img/s at batch 128).
+    """
+    if name != "InceptionV3":
+        return None
+    from sparkdl_tpu.models.inception_fast import inception_v3_fast_apply
+
+    compute_dtype = dtype or jnp.float32
+
+    def apply_fn(vs, x):
+        return inception_v3_fast_apply(vs, x, include_top=include_top,
+                                       pooling="avg",
+                                       compute_dtype=compute_dtype)
+
+    return apply_fn
+
+
 def build_featurizer(name: str, weights="random", seed: int = 0,
-                     dtype=None, preprocess: bool = True) -> ModelFunction:
+                     dtype=None, preprocess: bool = True,
+                     fast: bool = True) -> ModelFunction:
     """Headless named model as a ModelFunction emitting feature vectors.
 
     Input contract: float32 RGB [0,255] NHWC at the model's input size
     (host side resizes; scaling/mean-subtract runs on device, fused).
+    ``fast=False`` forces the plain Flax-module apply even where an
+    inference-specialized fast path exists.
     """
     spec = get_model_spec(name)
     kwargs = dict(spec.featurize_kwargs or {"include_top": False,
@@ -174,24 +198,37 @@ def build_featurizer(name: str, weights="random", seed: int = 0,
     module = spec.builder(**kwargs)
     input_spec = _spec_input(spec)
     variables = _resolve_variables(spec, module, weights, seed, input_spec)
-    mf = ModelFunction.fromFlax(module, variables, input_spec,
-                                name=f"{name}_featurize", train=False)
+    fast_apply = _fast_inference_apply(name, False, dtype) if fast else None
+    if fast_apply is not None:
+        mf = ModelFunction.fromFunction(fast_apply, variables, input_spec,
+                                        name=f"{name}_featurize")
+    else:
+        mf = ModelFunction.fromFlax(module, variables, input_spec,
+                                    name=f"{name}_featurize", train=False)
     if preprocess:
         mf = mf.with_preprocess(spec.preprocess)
+    mf.fast_path = fast_apply is not None
     return mf
 
 
 def build_predictor(name: str, weights="random", seed: int = 0,
-                    dtype=None, preprocess: bool = True) -> ModelFunction:
+                    dtype=None, preprocess: bool = True,
+                    fast: bool = True) -> ModelFunction:
     """Full named model (softmax probabilities) as a ModelFunction."""
     spec = get_model_spec(name)
     module = spec.builder(include_top=True, classes=spec.classes, dtype=dtype)
     input_spec = _spec_input(spec)
     variables = _resolve_variables(spec, module, weights, seed, input_spec)
-    mf = ModelFunction.fromFlax(module, variables, input_spec,
-                                name=f"{name}_predict", train=False)
+    fast_apply = _fast_inference_apply(name, True, dtype) if fast else None
+    if fast_apply is not None:
+        mf = ModelFunction.fromFunction(fast_apply, variables, input_spec,
+                                        name=f"{name}_predict")
+    else:
+        mf = ModelFunction.fromFlax(module, variables, input_spec,
+                                    name=f"{name}_predict", train=False)
     if preprocess:
         mf = mf.with_preprocess(spec.preprocess)
+    mf.fast_path = fast_apply is not None
     return mf
 
 

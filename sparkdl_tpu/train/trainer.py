@@ -25,6 +25,7 @@ import numpy as np
 import optax
 from flax import struct
 
+from sparkdl_tpu.core import profiling
 from sparkdl_tpu.core.mesh import batch_sharding, replicated
 from sparkdl_tpu.train.checkpoint import CheckpointManager
 from sparkdl_tpu.train.metrics import MetricsLogger
@@ -242,10 +243,14 @@ class Trainer:
                 if global_idx < done:
                     global_idx += 1
                     continue
-                state, metrics = train_step(state, jnp.asarray(x),
-                                            jnp.asarray(y))
+                # int(state.step) inside the span: it is the per-step sync
+                # point, so the timer records real step time, not just the
+                # async dispatch.
+                with profiling.annotate("sparkdl.train_step"):
+                    state, metrics = train_step(state, jnp.asarray(x),
+                                                jnp.asarray(y))
+                    step = int(state.step)
                 global_idx += 1
-                step = int(state.step)
                 if metrics_logger is not None:
                     metrics_logger.log_step(step, metrics, examples=len(x))
                 if (checkpoint is not None and checkpoint_every

@@ -1,0 +1,60 @@
+"""Fused InceptionV3 fast path == Flax module apply (f32, CPU).
+
+The fast path (models/inception_fast.py) folds BatchNorm into conv weights
+and fuses the parallel 1x1 branch convs; per-channel math is unchanged, so
+outputs must match the definitional module to float tolerance. Mirrors the
+reference's oracle pattern (SURVEY.md §4): optimized pipeline == plain
+framework forward.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparkdl_tpu.models.inception import InceptionV3
+from sparkdl_tpu.models.inception_fast import inception_v3_fast_apply
+
+
+@pytest.fixture(scope="module")
+def xin():
+    rng = np.random.default_rng(0)
+    return rng.uniform(-1.0, 1.0, size=(2, 299, 299, 3)).astype(np.float32)
+
+
+def _init(module):
+    return jax.jit(module.init)(jax.random.PRNGKey(0),
+                                jnp.zeros((1, 299, 299, 3), jnp.float32))
+
+
+def test_featurize_matches_module(xin):
+    mod = InceptionV3(include_top=False, pooling="avg")
+    vs = _init(mod)
+    want = np.asarray(mod.apply(vs, xin, train=False))
+    got = np.asarray(inception_v3_fast_apply(
+        vs, xin, include_top=False, compute_dtype=jnp.float32))
+    assert got.shape == want.shape == (2, 2048)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+def test_predict_matches_module(xin):
+    mod = InceptionV3(include_top=True, classes=1000)
+    vs = _init(mod)
+    want = np.asarray(mod.apply(vs, xin, train=False))
+    got = np.asarray(inception_v3_fast_apply(
+        vs, xin, include_top=True, compute_dtype=jnp.float32))
+    assert got.shape == want.shape == (2, 1000)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-5)
+
+
+def test_registry_featurizer_uses_fast_path_and_matches(xin):
+    from sparkdl_tpu.models import registry
+
+    fast = registry.build_featurizer("InceptionV3", weights="random")
+    slow = registry.build_featurizer("InceptionV3", weights="random",
+                                     fast=False)
+    # the fast path must actually be selected, else this is slow == slow
+    assert fast.fast_path and not slow.fast_path
+    a = np.asarray(fast(xin))
+    b = np.asarray(slow(xin))
+    np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-4)
