@@ -14,6 +14,19 @@ from typing import Callable, Iterator, Optional, Tuple
 
 import numpy as np
 
+# Transfer economics of the staging path (r3, measured with true barriers —
+# scalar fetched through a jitted reduction; block_until_ready is NOT a
+# reliable barrier here, see core/profiling.py):
+#   host→device ~47 MB/s regardless of chunking; device→host ~100 ms fixed
+#   latency + ~92 MB/s. A chunked device_put + on-device reassembly was
+#   tried and measured NO faster (the apparent 1.5 GB/s for small puts was
+#   async dispatch, not completed DMA). The levers that DO work: transfer
+#   uint8 not float32 (4x), resize to the model input size on the host
+#   BEFORE transfer when that shrinks bytes (native batch resizer), and
+#   fetch each partition's outputs as ONE device-concatenated array
+#   instead of one fetch per bucket (saves the ~100 ms fixed latency per
+#   batch).
+
 
 def pad_batch(arr: np.ndarray, batch_size: int) -> Tuple[np.ndarray, int]:
     """Zero-pad dim 0 up to ``batch_size``; returns (padded, n_valid)."""
@@ -64,9 +77,11 @@ def run_batched(fn: Callable[[np.ndarray], object], arr: np.ndarray,
     ``fn`` must accept the padded chunk and return a device array whose
     dim 0 aligns with the input rows (jit specializes per bucket shape).
     JAX's async dispatch overlaps the host staging of chunk k+1 with device
-    compute of chunk k: we dispatch all chunks before blocking on any
-    result. ``multiple``: bucket-size divisibility constraint (mesh data
-    axis).
+    compute of chunk k: all chunks are dispatched before blocking on any
+    result, and the per-bucket outputs are concatenated ON DEVICE so the
+    host pays ONE device→host fetch per call instead of one ~100 ms
+    round-trip per bucket. ``multiple``: bucket-size divisibility
+    constraint (mesh data axis).
     """
     outs = []
     valids = []
@@ -82,5 +97,14 @@ def run_batched(fn: Callable[[np.ndarray], object], arr: np.ndarray,
             (batch_size,) + arr.shape[1:], arr.dtype))
         return np.zeros((0,) + tuple(dummy.shape[1:]),
                         dtype=np.dtype(dummy.dtype))
-    host = [np.asarray(o)[:v] for o, v in zip(outs, valids)]
+    if len(outs) == 1:
+        return np.asarray(outs[0])[:valids[0]]
+    import jax.numpy as jnp
+
+    fetched = np.asarray(jnp.concatenate(outs, axis=0))
+    host = []
+    off = 0
+    for o, v in zip(outs, valids):
+        host.append(fetched[off:off + v])
+        off += o.shape[0]
     return np.concatenate(host, axis=0)

@@ -78,6 +78,7 @@ class ModelFunction:
         self.trainable_mask = trainable_mask
         self._jit_cache: Dict[Tuple, Callable] = {}
         self._flat_cache: Optional["ModelFunction"] = None
+        self._resize_cache: Dict[Tuple[int, int], "ModelFunction"] = {}
 
     # -- construction matrix (TFInputGraph parity) ---------------------------
 
@@ -240,6 +241,39 @@ class ModelFunction:
             self._flat_cache = self.with_postprocess(
                 lambda y: y.reshape(y.shape[0], -1))
         return self._flat_cache
+
+    def resized(self, src_size: Tuple[int, int],
+                target_size: Optional[Tuple[int, int]] = None
+                ) -> "ModelFunction":
+        """Model preceded by ON-DEVICE bilinear resize from (H, W) inputs.
+
+        ``target_size`` defaults to the input spec's spatial dims; pass it
+        explicitly when the caller's requested size differs from (or the
+        spec lacks) static spatial dims. The reference spliced
+        ``tf.image.resize_bilinear`` into the graph in front of the model
+        (``buildSpImageConverter``, SURVEY.md §3.2) — device-side, no
+        antialias; ``jax.image.resize`` with ``antialias=False`` reproduces
+        that. Memoized per (src, target) pair (one XLA program each).
+        """
+        target = (tuple(target_size) if target_size is not None
+                  else self.input_spec.spatial_size())
+        if target is None or tuple(src_size) == target:
+            return self
+        th, tw = target
+        cache = self._resize_cache
+        key = (tuple(src_size), target)
+        if key not in cache:
+            def pre(x):
+                xf = x.astype(jnp.dtype(self.input_spec.dtype))
+                return jax.image.resize(
+                    xf, (x.shape[0], th, tw, x.shape[3]),
+                    method="bilinear", antialias=False)
+
+            spec = TensorSpec((None, int(src_size[0]), int(src_size[1]),
+                               self.input_spec.shape[3]),
+                              self.input_spec.dtype)
+            cache[key] = self.with_preprocess(pre, input_spec=spec)
+        return cache[key]
 
     # -- execution -----------------------------------------------------------
 

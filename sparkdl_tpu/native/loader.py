@@ -81,6 +81,14 @@ def _load():
             ctypes.c_int,
         ]
         lib.sdl_decode_batch.restype = ctypes.c_int
+        if hasattr(lib, "sdl_resize_batch"):
+            lib.sdl_resize_batch.argtypes = [
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_int,
+                ctypes.c_int, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_int,
+                ctypes.c_int,
+            ]
+            lib.sdl_resize_batch.restype = ctypes.c_int
         _lib = lib
         return _lib
 
@@ -124,6 +132,33 @@ def decode_batch(blobs, target_size: Tuple[int, int], channels: int = 3,
         return None
     out, ok = res
     if not ok.all():
+        return None
+    return out
+
+
+def resize_batch(batch: np.ndarray, target_size: Tuple[int, int],
+                 num_threads: int = 0) -> Optional[np.ndarray]:
+    """Threaded bilinear resize of an NHWC uint8 batch (GIL released).
+
+    Returns the resized (N, th, tw, C) uint8 array, or None when the
+    native library is unavailable or lacks the entry point (older .so) —
+    callers fall back to per-row/device resize.
+    """
+    lib = _load()
+    if lib is None or not hasattr(lib, "sdl_resize_batch"):
+        return None
+    if batch.ndim != 4 or batch.dtype != np.uint8:
+        return None
+    batch = np.ascontiguousarray(batch)
+    n, sh, sw, c = batch.shape
+    th, tw = target_size
+    out = np.empty((n, th, tw, c), dtype=np.uint8)
+    rc = lib.sdl_resize_batch(
+        batch.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        n, sh, sw, c,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        th, tw, num_threads)
+    if rc != 0:
         return None
     return out
 

@@ -185,3 +185,27 @@ def test_struct_batch_array_preserves_uint8(rng):
         rng.normal(size=(8, 8, 3)).astype(np.float32)))
     mixed = imageIO.imageStructsToBatchArray(structs, dtype=None)
     assert mixed.dtype == np.float32
+
+
+def test_resize_batch_implementations_agree(rng):
+    """numpy resizeBatchArray == native sdl_resize_batch (to uint8 rounding).
+
+    Non-square source AND target so any H/W transpose in either
+    implementation fails loudly.
+    """
+    from sparkdl_tpu.native import loader as native_loader
+
+    batch = rng.integers(0, 255, size=(4, 40, 36, 3), dtype=np.uint8)
+    npy = imageIO.resizeBatchArray(batch, (24, 28))
+    assert npy.shape == (4, 24, 28, 3) and npy.dtype == np.uint8
+    if native_loader.available():
+        nat = native_loader.resize_batch(batch, (24, 28))
+        assert nat is not None and nat.shape == npy.shape
+        diff = np.abs(npy.astype(np.int32) - nat.astype(np.int32))
+        assert diff.max() <= 2, f"native vs numpy resize diverge: {diff.max()}"
+
+
+def test_resize_batch_float32_preserves_dtype(rng):
+    batch = rng.uniform(0, 1, size=(3, 16, 12, 3)).astype(np.float32)
+    out = imageIO.resizeBatchArray(batch, (8, 10))
+    assert out.shape == (3, 8, 10, 3) and out.dtype == np.float32

@@ -39,7 +39,12 @@ def test_featurizer_output_dim_and_determinism(image_df):
 
 
 def test_featurizer_matches_direct_model_function(image_df):
-    # oracle: the same registry ModelFunction applied by hand
+    # oracle: the same registry ModelFunction applied by hand, with the
+    # SAME resize policy the transformer's uniform fast path uses (host
+    # native downscale / device bilinear — both no-antialias pixel-center,
+    # NOT the PIL path; see ml/image_transformer._resize_uniform_batch).
+    from sparkdl_tpu.ml.image_transformer import _resize_uniform_batch
+
     f = DeepImageFeaturizer(inputCol="image", outputCol="features",
                             modelName="TestNet")
     got = np.array([r["features"]
@@ -47,10 +52,20 @@ def test_featurizer_matches_direct_model_function(image_df):
     mf = registry.build_featurizer("TestNet")
     spec = registry.get_model_spec("TestNet")
     structs = [r["image"] for r in image_df.collect()]
-    batch = imageIO.imageStructsToBatchArray(structs,
-                                             target_size=spec.input_size)
-    want = np.asarray(mf.apply_batch(batch, batch_size=8)).reshape(len(structs), -1)
+    batch = imageIO.imageStructsToBatchArray(structs, target_size=None,
+                                             dtype=None)
+    staged, run = _resize_uniform_batch(batch, spec.input_size, mf)
+    want = np.asarray(run.apply_batch(staged, batch_size=8)
+                      ).reshape(len(structs), -1)
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+    # Independent cross-implementation oracle: the numpy bilinear resize is
+    # a distinct implementation from whichever path the transform used
+    # (native C++ / device XLA); they agree to uint8 rounding. The 40x36
+    # non-square fixture makes an H/W transpose a hard failure here.
+    npy = imageIO.resizeBatchArray(batch, spec.input_size)
+    want_np = np.asarray(mf.apply_batch(npy, batch_size=8)
+                         ).reshape(len(structs), -1)
+    np.testing.assert_allclose(got, want_np, rtol=0.1, atol=0.02)
 
 
 def test_predictor_probabilities_sum_to_one(image_df):

