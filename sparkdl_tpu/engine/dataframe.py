@@ -199,6 +199,45 @@ class DataFrame:
         """Iterate materialized partitions (streaming consumption order)."""
         yield from self._materialize()
 
+    def streamPartitions(self, prefetch: int = 2,
+                         order: Optional[Sequence[int]] = None
+                         ) -> Iterable[pa.RecordBatch]:
+        """Compute and yield partitions one at a time WITHOUT caching.
+
+        Memory stays bounded by ``prefetch + 1`` computed partitions (the
+        streaming-``fit`` contract, SURVEY.md §3.3: the reference
+        ``collect()``-ed the dataset to the driver — its scalability
+        cliff). Re-iterating recomputes the op chain (use ``cache()``
+        first to trade memory for decode-once). Already-materialized
+        frames yield their cached partitions directly. ``order``: visit
+        partitions in this index order (per-epoch shuffle of a streaming
+        train loop).
+        """
+        indices = list(order) if order is not None else range(
+            len(self._partitions))
+        with self._lock:
+            materialized = self._materialized
+        if materialized is not None:
+            for i in indices:
+                yield materialized[i]
+            return
+        if not self._ops:
+            for i in indices:
+                yield self._partitions[i]
+            return
+        import collections as _collections
+
+        pending: "_collections.deque" = _collections.deque()
+        workers = max(1, min(EngineConfig.max_workers, prefetch + 1))
+        with _futures.ThreadPoolExecutor(workers) as pool:
+            for i in indices:
+                pending.append(pool.submit(_run_partition, i,
+                                           self._partitions[i], self._ops))
+                while len(pending) > prefetch:
+                    yield pending.popleft().result()
+            while pending:
+                yield pending.popleft().result()
+
     # -- transformations (lazy) ----------------------------------------------
 
     def _with_op(self, op: Callable[[pa.RecordBatch], pa.RecordBatch],

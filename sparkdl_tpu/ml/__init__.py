@@ -20,6 +20,7 @@ from sparkdl_tpu.ml.image_transformer import TPUImageTransformer
 from sparkdl_tpu.ml.keras_image import KerasImageFileTransformer
 from sparkdl_tpu.ml.keras_tensor import KerasTransformer
 from sparkdl_tpu.ml.named_image import DeepImageFeaturizer, DeepImagePredictor
+from sparkdl_tpu.ml.persistence import load
 from sparkdl_tpu.ml.tensor_transformer import TPUTransformer
 
 # Reference-compatible aliases: the reference's names execute TF graphs;
@@ -37,6 +38,7 @@ __all__ = [
     "KerasTransformer",
     "Model",
     "Pipeline",
+    "load",
     "PipelineModel",
     "Transformer",
     "TPUImageTransformer",

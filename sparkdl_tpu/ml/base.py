@@ -173,3 +173,32 @@ class PipelineModel(Model):
         that = PipelineModel([s.copy(extra) for s in self.stages])
         that.parent = self.parent
         return that
+
+    # -- persistence: one subdirectory per stage -----------------------------
+
+    def save(self, path: str) -> None:
+        import os
+
+        from sparkdl_tpu.ml import persistence as P
+
+        os.makedirs(path, exist_ok=True)
+        stage_dirs = []
+        for i, stage in enumerate(self.stages):
+            if not hasattr(stage, "save"):
+                raise ValueError(
+                    f"Pipeline stage {i} ({type(stage).__name__}) does not "
+                    "support save()")
+            sub = f"stage_{i:03d}_{type(stage).__name__}"
+            stage.save(os.path.join(path, sub))
+            stage_dirs.append(sub)
+        P.write_metadata(path, self, {"stage_dirs": stage_dirs}, {})
+
+    @classmethod
+    def _load_from(cls, path: str, meta):
+        import os
+
+        from sparkdl_tpu.ml import persistence as P
+
+        stages = [P.load(os.path.join(path, sub))
+                  for sub in meta["params"]["stage_dirs"]]
+        return cls(stages)

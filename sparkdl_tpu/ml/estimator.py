@@ -23,6 +23,7 @@ from sparkdl_tpu.core.model_function import ModelFunction
 from sparkdl_tpu.image import imageIO
 from sparkdl_tpu.ml.base import Estimator, Model
 from sparkdl_tpu.ml.image_transformer import TPUImageTransformer
+from sparkdl_tpu.ml.persistence import ModelFunctionPersistence
 from sparkdl_tpu.param.base import Param, keyword_only
 from sparkdl_tpu.param.converters import TypeConverters
 from sparkdl_tpu.param.shared_params import (
@@ -123,19 +124,28 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
 
     # -- data staging --------------------------------------------------------
 
-    def _collect_arrays(self, dataset) -> Tuple[np.ndarray, np.ndarray]:
-        """Decode+resize URIs and stack (X, y) host-side.
-
-        The decode runs partition-parallel in the engine (the reference ran
-        it as a Spark job); the stacked result is the host staging buffer
-        the train loop feeds to the device in fixed-size chunks.
-        """
+    def _loaded_frame(self, dataset):
+        """dataset + decoded image column (lazy; decode runs per partition)."""
         mf = self._model_function()
         shape = mf.input_spec.shape
         target_size = ((shape[1], shape[2])
                        if len(shape) == 4 and None not in shape[1:3] else None)
         loaded = self.loadImagesInternal(dataset, self.getInputCol(),
                                          _LOADED_COL, target_size=target_size)
+        return loaded, target_size
+
+    def _collect_arrays(self, dataset) -> Tuple[np.ndarray, np.ndarray]:
+        """Decode+resize URIs and stack (X, y) host-side.
+
+        The decode runs partition-parallel in the engine (the reference ran
+        it as a Spark job); the stacked result is the host staging buffer
+        the train loop feeds to the device in fixed-size chunks. Used by
+        ``fitMultiple`` (decode once, train many) and by
+        ``kerasFitParams={'streaming': False}``; plain ``fit`` streams
+        partitions instead (``_fit_streaming`` / ``_PartitionBatchStream``).
+        """
+        mf = self._model_function()
+        loaded, target_size = self._loaded_frame(dataset)
         rows = loaded.select(_LOADED_COL, self.getLabelCol()).collect()
         structs = [r[_LOADED_COL] for r in rows]
         labels = [r[self.getLabelCol()] for r in rows]
@@ -146,20 +156,85 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         y = np.asarray([labels[i] for i in keep])
         return x, y
 
-    def _prepare_labels(self, y: np.ndarray, mf: ModelFunction) -> np.ndarray:
+    def _label_preparer(self, mf: ModelFunction) -> Callable[[np.ndarray], np.ndarray]:
+        """Per-batch label transform; the n_classes probe (a whole-model
+        ``eval_shape`` trace) runs at most ONCE even when the streaming
+        path prepares labels partition by partition."""
         loss = self.getKerasLoss()
-        if "sparse" in loss:
-            return y.astype(np.int32)
-        if y.ndim == 1 and "crossentropy" in loss and "binary" not in loss:
-            out = jax.eval_shape(
-                mf.apply_fn, mf.variables,
-                jnp.zeros(mf.input_spec.with_batch(1),
-                          dtype=mf.input_spec.dtype))
-            n_classes = out.shape[-1]
-            return np.eye(n_classes, dtype=np.float32)[y.astype(np.int64)]
-        return y.astype(np.float32)
+        cache: Dict[str, int] = {}
+
+        def prepare(y: np.ndarray) -> np.ndarray:
+            if "sparse" in loss:
+                return y.astype(np.int32)
+            if y.ndim == 1 and "crossentropy" in loss and "binary" not in loss:
+                if "n_classes" not in cache:
+                    out = jax.eval_shape(
+                        mf.apply_fn, mf.variables,
+                        jnp.zeros(mf.input_spec.with_batch(1),
+                                  dtype=mf.input_spec.dtype))
+                    cache["n_classes"] = out.shape[-1]
+                return np.eye(cache["n_classes"],
+                              dtype=np.float32)[y.astype(np.int64)]
+            return y.astype(np.float32)
+
+        return prepare
+
+    def _prepare_labels(self, y: np.ndarray, mf: ModelFunction) -> np.ndarray:
+        return self._label_preparer(mf)(y)
 
     # -- fitting -------------------------------------------------------------
+
+    def _fit_streaming(self, dataset) -> "KerasImageFileModel":
+        """Streaming ``fit``: memory bounded by batch + a few partitions.
+
+        Replaces the reference's driver-side ``collect()`` (SURVEY.md §3.3's
+        scalability cliff): partitions decode lazily through the engine and
+        flow into fixed-shape train batches without materializing the
+        dataset. With ``shuffle`` rows mix through a windowed shuffle
+        buffer across partitions (an EXACT global permutation requires the
+        collected path, ``streaming=False``); with ``shuffle=False`` the
+        batch sequence is identical to the collected path's.
+        """
+        from sparkdl_tpu.core.mesh import data_axis_size, pad_to_multiple
+        from sparkdl_tpu.train.trainer import Trainer
+
+        mf = self._model_function()
+        fit_params = self.getKerasFitParams()
+        epochs = int(fit_params.get("epochs", 1))
+        batch_size = int(fit_params.get("batch_size", 32))
+        shuffle = bool(fit_params.get("shuffle", True))
+        seed = int(fit_params.get("seed", 0))
+        lr = fit_params.get("learning_rate")
+        mesh = self.resolveMesh()
+        multiple = 1
+        if mesh is not None:
+            multiple = data_axis_size(mesh)
+            batch_size = pad_to_multiple(batch_size, multiple)
+        loaded, target_size = self._loaded_frame(dataset)
+        frame = loaded.select(_LOADED_COL, self.getLabelCol())
+        stream = _PartitionBatchStream(
+            frame, _LOADED_COL, self.getLabelCol(), target_size,
+            str(mf.input_spec.dtype), batch_size, multiple, shuffle, seed,
+            self._label_preparer(mf))
+        trainer, state = Trainer.from_model_function(
+            mf, loss=self.getKerasLoss(), optimizer=self.getKerasOptimizer(),
+            learning_rate=lr, mesh=mesh)
+        state = trainer.fit(state, stream, epochs=epochs)
+        if stream.batches_last_epoch == 0:
+            raise ValueError("No decodable training images")
+        return self._wrap_trained(mf, state)
+
+    def _wrap_trained(self, mf: ModelFunction, state) -> "KerasImageFileModel":
+        trained = ModelFunction(mf.apply_fn, jax.device_get(state.params),
+                                mf.input_spec, name=mf.name + "_trained",
+                                trainable_mask=mf.trainable_mask)
+        model = KerasImageFileModel(
+            inputCol=self.getInputCol(), outputCol=self.getOutputCol(),
+            modelFunction=trained, outputMode=self.getOutputMode(),
+            batchSize=self.getBatchSize(), mesh=self.getMesh(),
+            imageLoader=self.getImageLoader())
+        model._set_parent(self)
+        return model
 
     def _fit_on_arrays(self, x: np.ndarray, y: np.ndarray
                        ) -> "KerasImageFileModel":
@@ -203,18 +278,11 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
             mf, loss=self.getKerasLoss(), optimizer=self.getKerasOptimizer(),
             learning_rate=lr, mesh=mesh)
         state = trainer.fit(state, batches, epochs=epochs)
-        trained = ModelFunction(mf.apply_fn, jax.device_get(state.params),
-                                mf.input_spec, name=mf.name + "_trained",
-                                trainable_mask=mf.trainable_mask)
-        model = KerasImageFileModel(
-            inputCol=self.getInputCol(), outputCol=self.getOutputCol(),
-            modelFunction=trained, outputMode=self.getOutputMode(),
-            batchSize=self.getBatchSize(), mesh=self.getMesh(),
-            imageLoader=self.getImageLoader())
-        model._set_parent(self)
-        return model
+        return self._wrap_trained(mf, state)
 
     def _fit(self, dataset) -> "KerasImageFileModel":
+        if bool(self.getKerasFitParams().get("streaming", True)):
+            return self._fit_streaming(dataset)
         x, y = self._collect_arrays(dataset)
         return self._fit_on_arrays(x, y)
 
@@ -245,9 +313,141 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         return _Iter()
 
 
+class _PartitionBatchStream:
+    """Reiterable fixed-shape (x, y) batch stream over engine partitions.
+
+    Each iteration (epoch) pulls partitions through
+    ``DataFrame.streamPartitions`` — nothing is materialized beyond the
+    prefetch window plus the shuffle pool — and decodes the image-struct
+    column (Arrow zero-copy fast path, per-row fallback). ``shuffle``
+    visits partitions in a fresh per-epoch order and mixes rows through a
+    ~4-batch windowed pool (tf.data-style buffer; deterministic in (seed,
+    epoch)); without it rows chain across partition boundaries in order,
+    matching the collected path's batch sequence exactly. The final
+    remainder is dropped (keras ``drop_remainder`` semantics) unless the
+    whole epoch would otherwise be empty, in which case one smaller batch
+    (rounded down to ``multiple`` for mesh shard divisibility) is yielded.
+    """
+
+    def __init__(self, frame, image_col: str, label_col: str,
+                 target_size, dtype: str, batch_size: int, multiple: int,
+                 shuffle: bool, seed: int,
+                 prepare_labels: Callable[[np.ndarray], np.ndarray]) -> None:
+        self._frame = frame
+        self._image_col = image_col
+        self._label_col = label_col
+        self._target_size = target_size
+        self._dtype = dtype
+        self._batch_size = batch_size
+        self._multiple = max(1, multiple)
+        self._shuffle = shuffle
+        self._seed = seed
+        self._prepare_labels = prepare_labels
+        self._epoch = 0
+        self.batches_last_epoch: Optional[int] = None
+
+    def _partition_arrays(self, part) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        idx = part.schema.get_field_index(self._image_col)
+        col = part.column(idx)
+        labels = part.column(part.schema.get_field_index(self._label_col))
+        fast = imageIO.arrowImageBatch(col)
+        if fast is not None:
+            x, valid_idx = fast
+            import pyarrow as pa
+
+            y = np.asarray(labels.take(pa.array(valid_idx)).to_pylist())
+        else:
+            structs = col.to_pylist()
+            valid = [i for i, s in enumerate(structs) if s is not None]
+            if not valid:
+                return None
+            x = imageIO.imageStructsToBatchArray(
+                [structs[i] for i in valid], target_size=self._target_size,
+                dtype=None)
+            lab = labels.to_pylist()
+            y = np.asarray([lab[i] for i in valid])
+        if x.shape[0] == 0:
+            return None
+        if (self._target_size is not None
+                and tuple(x.shape[1:3]) != tuple(self._target_size)):
+            # custom loaders may emit off-size structs; batch-resize here
+            x = imageIO.resizeBatchArray(x, tuple(self._target_size))
+        if x.dtype != np.dtype(self._dtype):
+            x = x.astype(self._dtype)
+        return x, self._prepare_labels(y)
+
+    def __iter__(self):
+        epoch = self._epoch
+        self._epoch += 1
+        bs = self._batch_size
+        emitted = 0
+        order = None
+        # Windowed shuffle (tf.data-style buffer): partitions are visited
+        # in a fresh per-epoch order and rows mix across a pool of
+        # ~4 batches + 1 partition before each emit — bounded memory,
+        # breaks class-clustered partition layouts. An EXACT global
+        # permutation needs the collected path (streaming=False).
+        pool_cap = bs * 4 if self._shuffle else 0
+        if self._shuffle:
+            order = np.random.default_rng(
+                (self._seed, epoch)).permutation(self._frame.numPartitions)
+        pool_x: Optional[np.ndarray] = None
+        pool_y: Optional[np.ndarray] = None
+        flush = 0
+
+        def shuffled_pool():
+            nonlocal flush
+            rng = np.random.default_rng((self._seed, epoch, flush))
+            flush += 1
+            perm = rng.permutation(len(pool_x))
+            return pool_x[perm], pool_y[perm]
+
+        for part in self._frame.streamPartitions(order=order):
+            arrays = self._partition_arrays(part)
+            if arrays is None:
+                continue
+            x, y = arrays
+            if pool_x is not None:
+                x = np.concatenate([pool_x, x])
+                y = np.concatenate([pool_y, y])
+            pool_x, pool_y = x, y
+            if len(pool_x) >= pool_cap + bs:
+                if self._shuffle:
+                    pool_x, pool_y = shuffled_pool()
+                emit = (len(pool_x) - pool_cap) // bs
+                for i in range(emit):
+                    emitted += 1
+                    yield pool_x[i * bs:(i + 1) * bs], pool_y[i * bs:(i + 1) * bs]
+                pool_x, pool_y = pool_x[emit * bs:], pool_y[emit * bs:]
+        if pool_x is not None and len(pool_x) > 0:
+            if self._shuffle:
+                pool_x, pool_y = shuffled_pool()
+            usable = (len(pool_x) // bs) * bs
+            for i in range(0, usable, bs):
+                emitted += 1
+                yield pool_x[i:i + bs], pool_y[i:i + bs]
+            if emitted == 0:
+                n = (len(pool_x) // self._multiple) * self._multiple
+                if n == 0:
+                    raise ValueError(
+                        f"dataset has {len(pool_x)} usable rows but the mesh "
+                        f"data axis requires a multiple of {self._multiple}")
+                emitted += 1
+                yield pool_x[:n], pool_y[:n]
+        self.batches_last_epoch = emitted
+
+
 class KerasImageFileModel(Model, HasInputCol, HasOutputCol, CanLoadImage,
-                          HasOutputMode, HasBatchSize, HasMesh):
-    """Fitted model: URI column → trained network → predictions column."""
+                          HasOutputMode, HasBatchSize, HasMesh,
+                          ModelFunctionPersistence):
+    """Fitted model: URI column → trained network → predictions column.
+
+    Persistence: the trained net round-trips as StableHLO with weights
+    baked in (``ModelFunctionPersistence``).
+    """
+
+    _persist_check_loader = True
+    _persist_name = "keras_image_file_model"
 
     modelFunction = Param("KerasImageFileModel", "modelFunction",
                           "trained ModelFunction",

@@ -26,6 +26,7 @@ from sparkdl_tpu.core import profiling
 from sparkdl_tpu.engine.dataframe import fixed_size_list_array
 from sparkdl_tpu.image import imageIO
 from sparkdl_tpu.ml.base import Transformer
+from sparkdl_tpu.ml.persistence import ModelFunctionPersistence
 from sparkdl_tpu.param.base import Param, keyword_only
 from sparkdl_tpu.param.converters import TypeConverters
 from sparkdl_tpu.param.shared_params import (
@@ -42,7 +43,7 @@ OUTPUT_MODES = ("vector", "image")
 
 class TPUImageTransformer(Transformer, HasInputCol, HasOutputCol,
                           HasModelFunction, HasOutputMode, HasBatchSize,
-                          HasMesh):
+                          HasMesh, ModelFunctionPersistence):
     """Apply a ModelFunction to an image-struct column.
 
     ``outputMode="vector"`` flattens model output per row into a fixed-size
@@ -87,6 +88,7 @@ class TPUImageTransformer(Transformer, HasInputCol, HasOutputCol,
 
     def getInputSize(self):
         return self.getOrDefault(self.inputSize)
+
 
     # -- execution -----------------------------------------------------------
 

@@ -13,8 +13,10 @@ from typing import Callable, Optional
 
 from sparkdl_tpu.ml.base import Transformer
 from sparkdl_tpu.ml.image_transformer import TPUImageTransformer
+from sparkdl_tpu.ml.persistence import ModelFunctionPersistence
 from sparkdl_tpu.param.base import keyword_only
 from sparkdl_tpu.param.shared_params import (
+    HasMesh,
     CanLoadImage,
     HasBatchSize,
     HasInputCol,
@@ -28,7 +30,8 @@ _LOADED_IMAGE_COL = "__sdl_loaded_image"
 
 class KerasImageFileTransformer(Transformer, HasInputCol, HasOutputCol,
                                 HasKerasModel, CanLoadImage, HasOutputMode,
-                                HasBatchSize):
+                                HasBatchSize, HasMesh,
+                                ModelFunctionPersistence):
     """Apply a Keras model (from file or object) to an image-URI column."""
 
     @keyword_only
@@ -38,7 +41,8 @@ class KerasImageFileTransformer(Transformer, HasInputCol, HasOutputCol,
                  model=None,
                  imageLoader: Optional[Callable] = None,
                  outputMode: str = "vector",
-                 batchSize: int = 64) -> None:
+                 batchSize: int = 64,
+                 mesh=None) -> None:
         super().__init__()
         self._setDefault(outputMode="vector", batchSize=64)
         self._mf_cache = None
@@ -52,7 +56,8 @@ class KerasImageFileTransformer(Transformer, HasInputCol, HasOutputCol,
                   model=None,
                   imageLoader: Optional[Callable] = None,
                   outputMode: str = "vector",
-                  batchSize: int = 64) -> "KerasImageFileTransformer":
+                  batchSize: int = 64,
+                  mesh=None) -> "KerasImageFileTransformer":
         kwargs = dict(self._input_kwargs)
         loader = kwargs.pop("imageLoader", None)
         if {"model", "modelFile"} & kwargs.keys():
@@ -80,6 +85,17 @@ class KerasImageFileTransformer(Transformer, HasInputCol, HasOutputCol,
         self._mf_cache = None
         return super().setModelFile(value)
 
+    # persistence: ingested Keras DAG → StableHLO (ModelFunctionPersistence)
+    _persist_skip = ("mesh", "modelFile")
+    _persist_check_loader = True
+    _persist_name = "keras_image_file"
+
+    def _persist_model_function(self):
+        return self._model_function()
+
+    def _restore_model_function(self, mf) -> None:
+        self._mf_cache = mf
+
     def _transform(self, dataset):
         mf = self._model_function()
         shape = mf.input_spec.shape
@@ -91,5 +107,5 @@ class KerasImageFileTransformer(Transformer, HasInputCol, HasOutputCol,
         inner = TPUImageTransformer(
             inputCol=_LOADED_IMAGE_COL, outputCol=self.getOutputCol(),
             modelFunction=mf, outputMode=self.getOutputMode(),
-            batchSize=self.getBatchSize())
+            batchSize=self.getBatchSize(), mesh=self.getMesh())
         return inner.transform(loaded).drop(_LOADED_IMAGE_COL)

@@ -10,9 +10,11 @@ from __future__ import annotations
 from typing import Optional
 
 from sparkdl_tpu.ml.base import Transformer
+from sparkdl_tpu.ml.persistence import ModelFunctionPersistence
 from sparkdl_tpu.ml.tensor_transformer import TPUTransformer
 from sparkdl_tpu.param.base import keyword_only
 from sparkdl_tpu.param.shared_params import (
+    HasMesh,
     HasBatchSize,
     HasInputCol,
     HasKerasModel,
@@ -21,7 +23,8 @@ from sparkdl_tpu.param.shared_params import (
 
 
 class KerasTransformer(Transformer, HasInputCol, HasOutputCol,
-                       HasKerasModel, HasBatchSize):
+                       HasKerasModel, HasBatchSize, HasMesh,
+                       ModelFunctionPersistence):
     """Apply a Keras model to a numeric column (1-D rows)."""
 
     @keyword_only
@@ -29,7 +32,8 @@ class KerasTransformer(Transformer, HasInputCol, HasOutputCol,
                  outputCol: Optional[str] = None,
                  modelFile: Optional[str] = None,
                  model=None,
-                 batchSize: int = 64) -> None:
+                 batchSize: int = 64,
+                 mesh=None) -> None:
         super().__init__()
         self._setDefault(batchSize=64)
         self._mf_cache = None
@@ -41,7 +45,8 @@ class KerasTransformer(Transformer, HasInputCol, HasOutputCol,
                   outputCol: Optional[str] = None,
                   modelFile: Optional[str] = None,
                   model=None,
-                  batchSize: int = 64) -> "KerasTransformer":
+                  batchSize: int = 64,
+                  mesh=None) -> "KerasTransformer":
         if {"model", "modelFile"} & self._input_kwargs.keys():
             self._mf_cache = None
         return self._set(**self._input_kwargs)
@@ -59,10 +64,23 @@ class KerasTransformer(Transformer, HasInputCol, HasOutputCol,
         self._mf_cache = None
         return super().setModelFile(value)
 
+    # persistence: ingested Keras DAG → StableHLO (ModelFunctionPersistence)
+    _persist_skip = ("mesh", "modelFile")
+    _persist_name = "keras_tensor"
+
+    def _persist_model_function(self):
+        if self._mf_cache is None:
+            self._mf_cache = self.loadKerasModelAsFunction()
+        return self._mf_cache
+
+    def _restore_model_function(self, mf) -> None:
+        self._mf_cache = mf
+
     def _transform(self, dataset):
         if self._mf_cache is None:
             self._mf_cache = self.loadKerasModelAsFunction()
         inner = TPUTransformer(
             inputCol=self.getInputCol(), outputCol=self.getOutputCol(),
-            modelFunction=self._mf_cache, batchSize=self.getBatchSize())
+            modelFunction=self._mf_cache, batchSize=self.getBatchSize(),
+            mesh=self.getMesh())
         return inner.transform(dataset)

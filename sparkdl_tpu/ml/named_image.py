@@ -106,6 +106,37 @@ class _NamedImageTransformer(Transformer, HasInputCol, HasOutputCol,
         that._mf_cache = {}
         return that
 
+    # -- persistence (SURVEY.md §5.4; see ml/persistence.py) -----------------
+
+    _persist_kind = "featurize"
+
+    def save(self, path: str) -> None:
+        from sparkdl_tpu.ml import persistence as P
+
+        os.makedirs(path, exist_ok=True)
+        params = P.jsonable_params(self, skip=("mesh", "weights", "dtype"))
+        params["dtype"] = P.dtype_name(self.getDtype())
+        artifacts = {}
+        weights = self.getWeights()
+        if isinstance(weights, str) and weights == "random":
+            # seeded init: rebuilding with the same marker reproduces it
+            params["weights"] = "random"
+        else:
+            mf = self._model_function(self._persist_kind)
+            artifacts["weights"] = P.save_weights_msgpack(mf.variables, path)
+        P.write_metadata(path, self, params, artifacts)
+
+    @classmethod
+    def _load_from(cls, path: str, meta):
+        kwargs = dict(meta["params"])
+        dtype = kwargs.pop("dtype", None)
+        if "weights" in meta["artifacts"]:
+            kwargs["weights"] = os.path.join(path, meta["artifacts"]["weights"])
+        inst = cls(**kwargs)
+        if dtype is not None:
+            inst.setDtype(np.dtype(dtype))
+        return inst
+
 
 class DeepImageFeaturizer(_NamedImageTransformer):
     """Headless named CNN → feature-vector column (transfer learning).
@@ -147,6 +178,8 @@ class DeepImageFeaturizer(_NamedImageTransformer):
 
 class DeepImagePredictor(_NamedImageTransformer):
     """Full named CNN → class-probability column, optionally decoded top-K."""
+
+    _persist_kind = "predict"
 
     decodePredictions = Param(
         "DeepImagePredictor", "decodePredictions",

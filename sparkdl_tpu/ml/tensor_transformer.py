@@ -16,6 +16,7 @@ import pyarrow as pa
 
 from sparkdl_tpu.engine.dataframe import column_to_numpy, fixed_size_list_array
 from sparkdl_tpu.ml.base import Transformer
+from sparkdl_tpu.ml.persistence import ModelFunctionPersistence
 from sparkdl_tpu.param.base import keyword_only
 from sparkdl_tpu.param.shared_params import (
     HasBatchSize,
@@ -47,8 +48,11 @@ def column_to_block(column: pa.Array, element_shape) -> np.ndarray:
 
 
 class TPUTransformer(Transformer, HasInputCol, HasOutputCol,
-                     HasModelFunction, HasBatchSize, HasMesh):
+                     HasModelFunction, HasBatchSize, HasMesh,
+                     ModelFunctionPersistence):
     """Apply a ModelFunction to a numeric column, emitting list<float32>."""
+
+    _persist_name = "tpu_transformer"
 
     @keyword_only
     def __init__(self, *, inputCol: Optional[str] = None,
@@ -68,6 +72,7 @@ class TPUTransformer(Transformer, HasInputCol, HasOutputCol,
                   batchSize: int = 64,
                   mesh=None) -> "TPUTransformer":
         return self._set(**self._input_kwargs)
+
 
     def _transform(self, dataset):
         model = self.getModelFunction()

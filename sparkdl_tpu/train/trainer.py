@@ -230,8 +230,23 @@ class Trainer:
                 state = checkpoint.restore(state)
                 state = jax.tree.map(jnp.asarray, state)
         train_step = self.make_train_step()
+        multihost = self.mesh is not None and jax.process_count() > 1
         if self.mesh is not None:
             state = jax.device_put(state, replicated(self.mesh))
+
+        def stage_batch(arr):
+            """Host batch → device array sharded over ``data``.
+
+            Multi-host (SURVEY.md §5.8, HorovodRunner parity): every
+            process passes its LOCAL rows; the global array is assembled
+            from the process-local shards — the per-host input feeding the
+            reference achieved with one Spark partition per worker.
+            """
+            arr = np.asarray(arr)
+            if multihost:
+                sharding = batch_sharding(self.mesh, arr.ndim)
+                return jax.make_array_from_process_local_data(sharding, arr)
+            return jnp.asarray(arr)
 
         # Exact resume: the loop replays the (deterministic) batch stream and
         # skips the first `state.step` positions — mid-epoch restarts land on
@@ -247,8 +262,8 @@ class Trainer:
                 # point, so the timer records real step time, not just the
                 # async dispatch.
                 with profiling.annotate("sparkdl.train_step"):
-                    state, metrics = train_step(state, jnp.asarray(x),
-                                                jnp.asarray(y))
+                    state, metrics = train_step(state, stage_batch(x),
+                                                stage_batch(y))
                     step = int(state.step)
                 global_idx += 1
                 if metrics_logger is not None:

@@ -178,3 +178,86 @@ def test_load_images_internal_batch_equals_per_row(labeled_image_df):
         xa = imageIO.imageStructToArray(sa).astype(int)
         xb = imageIO.imageStructToArray(sb).astype(int)
         assert np.abs(xa - xb).max() <= 2  # decoder-family rounding only
+
+
+def test_streaming_fit_identical_to_collected(labeled_image_df):
+    """shuffle=False: the streaming batch sequence equals the collected
+    path's, so the trained params must be bit-identical."""
+    shared_model = _tiny_cnn()  # same initial weights for both paths
+
+    def make_est(streaming):
+        return KerasImageFileEstimator(
+            inputCol="uri", outputCol="preds", labelCol="label",
+            model=shared_model, kerasOptimizer="sgd",
+            kerasLoss="categorical_crossentropy",
+            kerasFitParams={"epochs": 3, "batch_size": 8, "shuffle": False,
+                            "learning_rate": 0.05, "streaming": streaming})
+
+    m_stream = make_est(True).fit(labeled_image_df)
+    m_collect = make_est(False).fit(labeled_image_df)
+    ps = m_stream.getModelFunction().variables
+    pc = m_collect.getModelFunction().variables
+    import jax
+    for a, b in zip(jax.tree.leaves(ps), jax.tree.leaves(pc)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_streaming_fit_many_partitions_bounded(labeled_image_df, monkeypatch):
+    """Streaming must never materialize the whole frame: cap concurrently
+    outstanding computed partitions at the prefetch window."""
+    from sparkdl_tpu.engine import dataframe as edf
+
+    in_flight = {"now": 0, "peak": 0}
+    real = edf._run_partition
+
+    def tracked(index, batch, ops):
+        in_flight["now"] += 1
+        in_flight["peak"] = max(in_flight["peak"], in_flight["now"])
+        try:
+            return real(index, batch, ops)
+        finally:
+            in_flight["now"] -= 1
+
+    monkeypatch.setattr(edf, "_run_partition", tracked)
+    df = labeled_image_df.repartition(12)
+    est = KerasImageFileEstimator(
+        inputCol="uri", outputCol="preds", labelCol="label",
+        model=_tiny_cnn(), kerasOptimizer="sgd",
+        kerasLoss="categorical_crossentropy",
+        kerasFitParams={"epochs": 2, "batch_size": 4, "shuffle": True})
+    est.fit(df)
+    # streamPartitions(prefetch=2) => at most prefetch+1 in flight
+    assert 0 < in_flight["peak"] <= 3
+
+
+def test_stream_partitions_does_not_cache(labeled_image_df):
+    from sparkdl_tpu.image import imageIO
+
+    df = labeled_image_df.withColumn(
+        "h", lambda u: len(u), inputCols=["uri"])
+    parts1 = list(df.streamPartitions())
+    assert df._materialized is None  # nothing cached
+    parts2 = list(df.streamPartitions())
+    assert [p.num_rows for p in parts1] == [p.num_rows for p in parts2]
+
+
+def test_streaming_fit_small_dataset_single_batch(tmp_path):
+    """Fewer rows than batch_size: one smaller batch, like the collected
+    path's clamp."""
+    from PIL import Image
+
+    rng = np.random.default_rng(1)
+    rows = []
+    for i in range(5):
+        arr = rng.integers(0, 255, size=(8, 8, 3), dtype=np.uint8)
+        p = tmp_path / f"s{i}.png"
+        Image.fromarray(arr).save(p)
+        rows.append({"uri": str(p), "label": i % 2})
+    df = DataFrame.fromRows(rows, numPartitions=2)
+    est = KerasImageFileEstimator(
+        inputCol="uri", outputCol="preds", labelCol="label",
+        model=_tiny_cnn(), kerasOptimizer="sgd",
+        kerasLoss="categorical_crossentropy",
+        kerasFitParams={"epochs": 1, "batch_size": 64})
+    model = est.fit(df)
+    assert model.getModelFunction() is not None

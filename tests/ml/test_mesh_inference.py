@@ -146,3 +146,53 @@ def test_estimator_mesh_trained_model_transforms_on_mesh(tmp_path, mesh8):
     preds = np.array([np.argmax(r["preds"]) for r in out])
     labels = np.array([r["label"] for r in out])
     assert (preds == labels).mean() >= 0.9
+
+
+def test_keras_transformer_mesh_matches_single_device(rng, mesh8):
+    keras = pytest.importorskip("keras")
+    from keras import layers
+
+    from sparkdl_tpu.ml import KerasTransformer
+
+    m = keras.Sequential([keras.Input((6,)),
+                          layers.Dense(8, activation="relu"),
+                          layers.Dense(3)])
+    x = rng.normal(size=(13, 6)).astype(np.float32)  # non-multiple of 8
+    df = DataFrame.fromColumns({"features": x}, numPartitions=2)
+
+    def run(mesh):
+        t = KerasTransformer(inputCol="features", outputCol="out",
+                             model=m, batchSize=8, mesh=mesh)
+        return np.array([r["out"] for r in t.transform(df).collect()],
+                        dtype=np.float32)
+
+    np.testing.assert_allclose(run(mesh8), run(None), rtol=1e-6, atol=1e-6)
+
+
+def test_keras_image_file_transformer_mesh_matches_single_device(
+        rng, mesh8, tmp_path):
+    keras = pytest.importorskip("keras")
+    from keras import layers
+    from PIL import Image
+
+    from sparkdl_tpu.ml import KerasImageFileTransformer
+
+    m = keras.Sequential([keras.Input((16, 16, 3)),
+                          layers.Conv2D(4, 3, activation="relu"),
+                          layers.GlobalAveragePooling2D(),
+                          layers.Dense(2)])
+    uris = []
+    for i in range(9):  # non-multiple of 8
+        p = tmp_path / f"img{i}.png"
+        Image.fromarray(rng.integers(0, 255, size=(16, 16, 3),
+                                     dtype=np.uint8)).save(p)
+        uris.append("file:" + str(p))
+    df = DataFrame.fromColumns({"uri": uris}, numPartitions=2)
+
+    def run(mesh):
+        t = KerasImageFileTransformer(inputCol="uri", outputCol="out",
+                                      model=m, batchSize=8, mesh=mesh)
+        return np.array([r["out"] for r in t.transform(df).collect()],
+                        dtype=np.float32)
+
+    np.testing.assert_allclose(run(mesh8), run(None), rtol=1e-6, atol=1e-6)

@@ -1,0 +1,85 @@
+"""Worker process for the 2-process jax.distributed CPU test.
+
+Each of the two processes owns 4 virtual CPU devices (8 global), joins the
+process group via the SPARKDL_* env triple (train/runner.py), builds the
+global data mesh, and feeds its LOCAL half of every deterministic global
+batch through Trainer.fit — the per-host input feeding of SURVEY.md §5.8.
+Process 0 writes the final params for comparison against a single-process
+run of the same global batches.
+
+Usage: python _multihost_worker.py <out_dir>
+(env: SPARKDL_COORDINATOR/NUM_PROCESSES/PROCESS_ID set by the test)
+"""
+
+import os
+import sys
+
+if __name__ == "__main__":
+    # Worker-only env: MUST precede the first jax import. Guarded so that
+    # importing this module from the pytest process (for build_trainer /
+    # global_batches) does not mutate its env or jax config.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=4")
+
+import jax  # noqa: E402
+
+if __name__ == "__main__":
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from sparkdl_tpu.core.mesh import MeshConfig, make_mesh  # noqa: E402
+from sparkdl_tpu.models import registry  # noqa: E402
+from sparkdl_tpu.train import Trainer  # noqa: E402
+from sparkdl_tpu.train.runner import maybe_initialize_distributed  # noqa: E402
+
+GLOBAL_BATCH = 16
+STEPS = 3
+
+
+def global_batches():
+    rng = np.random.default_rng(0)
+    x = rng.uniform(0, 1, size=(STEPS * GLOBAL_BATCH, 32, 32, 3)
+                    ).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[
+        rng.integers(0, 10, size=STEPS * GLOBAL_BATCH)]
+    return [(x[s * GLOBAL_BATCH:(s + 1) * GLOBAL_BATCH],
+             y[s * GLOBAL_BATCH:(s + 1) * GLOBAL_BATCH])
+            for s in range(STEPS)]
+
+
+def build_trainer(mesh):
+    spec = registry.get_model_spec("TestNet")
+    module = spec.builder(include_top=True, classes=spec.classes)
+    variables = module.init(jax.random.PRNGKey(0),
+                            np.zeros((1, 32, 32, 3), np.float32))
+    return Trainer.from_flax(module, variables,
+                             loss="categorical_crossentropy",
+                             optimizer="sgd", learning_rate=0.05, mesh=mesh)
+
+
+def main(out_dir: str) -> None:
+    assert maybe_initialize_distributed(), "SPARKDL_* env triple not set"
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 8, len(jax.devices())
+    mesh = make_mesh(MeshConfig(data=8))
+    pid = jax.process_index()
+    per = GLOBAL_BATCH // 2
+    local = [(x[pid * per:(pid + 1) * per], y[pid * per:(pid + 1) * per])
+             for x, y in global_batches()]
+    trainer, state = build_trainer(mesh)
+    state = trainer.fit(state, local, epochs=1)
+    assert int(state.step) == STEPS
+    params = jax.device_get(state.params)
+    if pid == 0:
+        flat = np.concatenate([np.ravel(leaf)
+                               for leaf in jax.tree.leaves(params)])
+        np.save(os.path.join(out_dir, "multihost_params.npy"), flat)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

@@ -1,6 +1,8 @@
 """TPURunner: mesh provisioning, restart-from-checkpoint gang semantics,
 fault injection (SURVEY.md §3.5, §5.3)."""
 
+import os
+
 import numpy as np
 import pytest
 import jax
@@ -76,3 +78,52 @@ def test_runner_exhausted_restarts_raise():
 
     with pytest.raises(RuntimeError, match="after 2 attempts"):
         TPURunner(np=2, max_restarts=1).run(always_fail)
+
+
+def test_two_process_distributed_training_matches_single(tmp_path):
+    """2-process jax.distributed on CPU (SURVEY.md §5.8, §3.5): each
+    process feeds its local half of every global batch; the trained params
+    must equal a single-process run over the same global batches."""
+    import socket
+    import subprocess
+    import sys
+
+    import jax
+
+    from sparkdl_tpu.core.mesh import MeshConfig, make_mesh
+
+    worker = os.path.join(os.path.dirname(__file__), "_multihost_worker.py")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)  # worker sets its own device count (4)
+        env.update({
+            "SPARKDL_COORDINATOR": f"127.0.0.1:{port}",
+            "SPARKDL_NUM_PROCESSES": "2",
+            "SPARKDL_PROCESS_ID": str(pid),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, worker, str(tmp_path)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    outs = [p.communicate(timeout=240)[0] for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out.decode(errors="replace")[-3000:]
+
+    got = np.load(tmp_path / "multihost_params.npy")
+
+    # single-process reference over the SAME global batches (8 local devices)
+    sys.path.insert(0, os.path.dirname(worker))
+    try:
+        import _multihost_worker as w
+    finally:
+        sys.path.pop(0)
+    mesh = make_mesh(MeshConfig(data=8))
+    trainer, state = w.build_trainer(mesh)
+    state = trainer.fit(state, w.global_batches(), epochs=1)
+    want = np.concatenate([np.ravel(leaf) for leaf in jax.tree.leaves(
+        jax.device_get(state.params))])
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
