@@ -53,9 +53,10 @@ def bucket_size(n: int, batch_size: int, multiple: int = 1,
     while b < n:
         b <<= 1
     b = min(b, batch_size)
+    b = max(b, n)  # n > batch_size: bucket covers n (public-helper use)
     if b % multiple:
         b = int(-(-b // multiple) * multiple)
-    return max(b, n)
+    return b
 
 
 def iter_batches(arr: np.ndarray, batch_size: int, multiple: int = 1
@@ -70,41 +71,80 @@ def iter_batches(arr: np.ndarray, batch_size: int, multiple: int = 1
         yield pad_batch(chunk, bucket_size(len(chunk), batch_size, multiple))
 
 
-def run_batched(fn: Callable[[np.ndarray], object], arr: np.ndarray,
-                batch_size: int, multiple: int = 1) -> np.ndarray:
+def iter_batches_tree(tree, batch_size: int, multiple: int = 1):
+    """``iter_batches`` over a pytree of dim-0-aligned arrays.
+
+    Multi-input models take a dict of arrays sharing the batch dim
+    (the reference ``TFTransformer``'s feed-dict analog); every leaf is
+    chunked and padded identically.
+    """
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    n = leaves[0].shape[0]
+    for leaf in leaves[1:]:
+        if leaf.shape[0] != n:
+            raise ValueError(
+                f"multi-input batch dims disagree: {leaf.shape[0]} vs {n}")
+    if n == 0:
+        return
+    for start in range(0, n, batch_size):
+        chunk_leaves = []
+        n_valid = min(batch_size, n - start)
+        bucket = bucket_size(n_valid, batch_size, multiple)
+        for leaf in leaves:
+            padded, _ = pad_batch(leaf[start:start + batch_size], bucket)
+            chunk_leaves.append(padded)
+        yield treedef.unflatten(chunk_leaves), n_valid
+
+
+def run_batched(fn: Callable, tree, batch_size: int,
+                multiple: int = 1):
     """Apply a fixed-batch device fn over all rows, concatenating outputs.
 
-    ``fn`` must accept the padded chunk and return a device array whose
-    dim 0 aligns with the input rows (jit specializes per bucket shape).
-    JAX's async dispatch overlaps the host staging of chunk k+1 with device
-    compute of chunk k: all chunks are dispatched before blocking on any
-    result, and the per-bucket outputs are concatenated ON DEVICE so the
-    host pays ONE device→host fetch per call instead of one ~100 ms
-    round-trip per bucket. ``multiple``: bucket-size divisibility
-    constraint (mesh data axis).
+    ``tree``: one array or a pytree of dim-0-aligned arrays (multi-input
+    models). ``fn`` must accept the padded chunk and return a device array
+    (or pytree of them) whose dim 0 aligns with the input rows (jit
+    specializes per bucket shape). JAX's async dispatch overlaps the host
+    staging of chunk k+1 with device compute of chunk k: all chunks are
+    dispatched before blocking on any result, and the per-bucket outputs
+    are concatenated ON DEVICE so the host pays ONE device→host fetch per
+    leaf per call instead of one ~100 ms round-trip per bucket.
+    ``multiple``: bucket-size divisibility constraint (mesh data axis).
     """
+    import jax
+
     outs = []
     valids = []
-    for chunk, n_valid in iter_batches(arr, batch_size, multiple):
+    for chunk, n_valid in iter_batches_tree(tree, batch_size, multiple):
         outs.append(fn(chunk))  # dispatched async; do not block here
         valids.append(n_valid)
     if not outs:
         # Preserve the output *element* shape for empty inputs: run one
         # dummy padded batch through shape inference only.
-        import jax
+        dummy_in = jax.tree_util.tree_map(
+            lambda leaf: jax.ShapeDtypeStruct(
+                (batch_size,) + leaf.shape[1:], leaf.dtype), tree)
+        dummy = jax.eval_shape(fn, dummy_in)
+        return jax.tree_util.tree_map(
+            lambda d: np.zeros((0,) + tuple(d.shape[1:]),
+                               dtype=np.dtype(d.dtype)), dummy)
 
-        dummy = jax.eval_shape(fn, jax.ShapeDtypeStruct(
-            (batch_size,) + arr.shape[1:], arr.dtype))
-        return np.zeros((0,) + tuple(dummy.shape[1:]),
-                        dtype=np.dtype(dummy.dtype))
-    if len(outs) == 1:
-        return np.asarray(outs[0])[:valids[0]]
-    import jax.numpy as jnp
+    flat_outs = [jax.tree_util.tree_flatten(o) for o in outs]
+    treedef_out = flat_outs[0][1]
+    result_leaves = []
+    for j in range(len(flat_outs[0][0])):
+        leaf_per_batch = [f[0][j] for f in flat_outs]
+        if len(leaf_per_batch) == 1:
+            result_leaves.append(np.asarray(leaf_per_batch[0])[:valids[0]])
+            continue
+        import jax.numpy as jnp
 
-    fetched = np.asarray(jnp.concatenate(outs, axis=0))
-    host = []
-    off = 0
-    for o, v in zip(outs, valids):
-        host.append(fetched[off:off + v])
-        off += o.shape[0]
-    return np.concatenate(host, axis=0)
+        fetched = np.asarray(jnp.concatenate(leaf_per_batch, axis=0))
+        host = []
+        off = 0
+        for o, v in zip(leaf_per_batch, valids):
+            host.append(fetched[off:off + v])
+            off += o.shape[0]
+        result_leaves.append(np.concatenate(host, axis=0))
+    return treedef_out.unflatten(result_leaves)

@@ -1,0 +1,62 @@
+"""Debug hardening (SURVEY.md §5.2).
+
+The reference had no sanitizers of its own (JVM memory model + TF session
+thread-safety); the TPU-native equivalents are JAX's numeric and tracer
+sanitizers, packaged here:
+
+- ``debug_mode()`` — context manager enabling ``jax_debug_nans`` (every
+  primitive re-checked; a NaN raises ``FloatingPointError`` at the op that
+  produced it instead of poisoning downstream metrics) and
+  ``jax_check_tracer_leaks`` (escaped tracers raise at the leak site).
+- ``SPARKDL_DEBUG=1`` — tests/conftest.py enables both suite-wide; off by
+  default because op-by-op NaN re-checking disables fusion and slows whole
+  models by orders of magnitude.
+
+Use around a failing fit::
+
+    from sparkdl_tpu.core.debug import debug_mode
+    with debug_mode():
+        estimator.fit(df)   # raises at the first NaN-producing op
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterator
+
+DEBUG_ENV = "SPARKDL_DEBUG"
+
+
+@contextlib.contextmanager
+def debug_mode(nans: bool = True, leaks: bool = True) -> Iterator[None]:
+    """Enable NaN checking and tracer-leak checking within the scope."""
+    import jax
+
+    managers = []
+    if nans:
+        managers.append(("jax_debug_nans", True))
+    if leaks:
+        managers.append(("jax_check_tracer_leaks", True))
+    with contextlib.ExitStack() as stack:
+        for name, value in managers:
+            # jax.config attributes are context-manager capable via
+            # jax.config.update + restore; use the documented option CM.
+            stack.enter_context(_option(name, value))
+        yield
+
+
+@contextlib.contextmanager
+def _option(name: str, value) -> Iterator[None]:
+    import jax
+
+    old = getattr(jax.config, name)
+    jax.config.update(name, value)
+    try:
+        yield
+    finally:
+        jax.config.update(name, old)
+
+
+def debug_enabled() -> bool:
+    return os.environ.get(DEBUG_ENV, "") not in ("", "0")

@@ -109,34 +109,48 @@ def pad_to_multiple(n: int, multiple: int) -> int:
 # implicitly; the rebuild's analog is one framework-level default mesh that
 # every transformer/UDF uses unless given an explicit ``mesh`` param — so
 # ``set_default_mesh(data_parallel_mesh())`` makes the whole API multi-chip.
+#
+# Two layers (ADVICE r2): ``set_default_mesh`` is process-wide (visible
+# from every thread — engine workers included), while ``use_mesh`` scoping
+# is a ContextVar, so concurrent transforms in different threads/contexts
+# can never observe each other's scoped mesh or race on restore.
 
-_default_mesh: Optional[Mesh] = None
+import contextvars as _contextvars
+
+_global_default_mesh: Optional[Mesh] = None
+_UNSET = object()
+_scoped_mesh: "_contextvars.ContextVar" = _contextvars.ContextVar(
+    "sparkdl_scoped_mesh", default=_UNSET)
 
 
 def set_default_mesh(mesh: Optional[Mesh]) -> Optional[Mesh]:
     """Set (or clear, with None) the process-wide default mesh."""
-    global _default_mesh
-    _default_mesh = mesh
+    global _global_default_mesh
+    _global_default_mesh = mesh
     return mesh
 
 
 def get_default_mesh() -> Optional[Mesh]:
-    return _default_mesh
+    scoped = _scoped_mesh.get()
+    if scoped is not _UNSET:
+        return scoped
+    return _global_default_mesh
 
 
 class use_mesh:
-    """Context manager: ``with use_mesh(mesh): ...`` scopes the default."""
+    """Context manager: ``with use_mesh(mesh): ...`` scopes the default.
+
+    Context-local: ``use_mesh(None)`` masks the process default inside the
+    scope; other threads/contexts are unaffected.
+    """
 
     def __init__(self, mesh: Optional[Mesh]) -> None:
         self._mesh = mesh
-        self._prev: Optional[Mesh] = None
+        self._token = None
 
     def __enter__(self) -> Optional[Mesh]:
-        global _default_mesh
-        self._prev = _default_mesh
-        _default_mesh = self._mesh
+        self._token = _scoped_mesh.set(self._mesh)
         return self._mesh
 
     def __exit__(self, *exc) -> None:
-        global _default_mesh
-        _default_mesh = self._prev
+        _scoped_mesh.reset(self._token)

@@ -148,10 +148,17 @@ class ModelFunction:
             with open(path_or_bytes, "rb") as f:
                 blob = f.read()
         exported = jex.deserialize(blob)
-        aval = exported.in_avals[0]
-        shape = tuple(None if not isinstance(d, int) else int(d)
-                      for d in aval.shape)
-        spec = TensorSpec(shape, np.dtype(aval.dtype).name)
+
+        def aval_to_spec(aval) -> TensorSpec:
+            shape = tuple(None if not isinstance(d, int) else int(d)
+                          for d in aval.shape)
+            return TensorSpec(shape, np.dtype(aval.dtype).name)
+
+        # in_tree describes the ((args,), kwargs) of the exported call;
+        # rebuild the input structure (array or {name: spec} dict).
+        args, _kwargs = jax.tree_util.tree_unflatten(
+            exported.in_tree, list(exported.in_avals))
+        spec = jax.tree_util.tree_map(aval_to_spec, args[0])
 
         def apply_fn(_vs, x):
             return exported.call(x)
@@ -179,19 +186,29 @@ class ModelFunction:
 
         With ``batch_size=None`` the batch dim is exported symbolically so
         the artifact runs at any batch size; pass a fixed size if symbolic
-        export is unsupported for the program.
+        export is unsupported for the program. Dict input specs export with
+        ONE shared symbolic batch dim across all inputs.
         """
         import jax.export as jex
 
         def fn(x):
             return self.apply_fn(self.variables, x)
 
-        if batch_size is None:
-            dims = ",".join(["b"] + [str(d) for d in self.input_spec.element_shape])
-            shape = jex.symbolic_shape(dims)
+        scope = jex.SymbolicScope() if batch_size is None else None
+
+        def make_arg(spec: TensorSpec):
+            if batch_size is None:
+                dims = ",".join(["b"] + [str(d) for d in spec.element_shape])
+                shape = jex.symbolic_shape(dims, scope=scope)
+            else:
+                shape = spec.with_batch(batch_size)
+            return jax.ShapeDtypeStruct(shape, jnp.dtype(spec.dtype))
+
+        if isinstance(self.input_spec, dict):
+            arg = {name: make_arg(spec)
+                   for name, spec in self.input_spec.items()}
         else:
-            shape = self.input_spec.with_batch(batch_size)
-        arg = jax.ShapeDtypeStruct(shape, jnp.dtype(self.input_spec.dtype))
+            arg = make_arg(self.input_spec)
         exported = jex.export(jax.jit(fn))(arg)
         blob = exported.serialize()
         if path is not None:
@@ -293,12 +310,19 @@ class ModelFunction:
         if cached is not None:
             return cached
 
-        dtype = jnp.dtype(self.input_spec.dtype)
+        specs = self.input_spec
         inner_apply = self.apply_fn
 
+        def cast_one(x, spec):
+            dtype = jnp.dtype(spec.dtype)
+            return x.astype(dtype) if x.dtype != dtype else x
+
         def apply_fn(vs, x):
-            if x.dtype != dtype:
-                x = x.astype(dtype)
+            if isinstance(specs, dict):
+                x = {name: cast_one(x[name], spec)
+                     for name, spec in specs.items()}
+            else:
+                x = cast_one(x, specs)
             return inner_apply(vs, x)
 
         if mesh is None:
@@ -315,17 +339,29 @@ class ModelFunction:
         self._jit_cache[key] = fn
         return fn
 
-    def apply_batch(self, array: np.ndarray, batch_size: int = 64,
+    def apply_batch(self, array, batch_size: int = 64,
                     mesh=None) -> np.ndarray:
         """Run over N rows with fixed-shape padded chunks; returns numpy.
 
-        uint8 input stages as uint8 (the jitted program casts on device —
-        quarter the transfer bytes); anything else is cast host-side to the
-        spec dtype.
+        ``array``: one ndarray, or — for multi-input models whose
+        ``input_spec`` is a ``{name: TensorSpec}`` dict — a dict of
+        dim-0-aligned ndarrays (the reference ``TFTransformer`` feed-dict
+        analog); outputs mirror the model's structure. uint8 input stages
+        as uint8 (the jitted program casts on device — quarter the
+        transfer bytes); anything else is cast host-side to the spec dtype.
         """
-        array = np.asarray(array)
-        if array.dtype != np.uint8 and array.dtype != np.dtype(self.input_spec.dtype):
-            array = array.astype(self.input_spec.dtype)
+
+        def stage_cast(arr, spec):
+            arr = np.asarray(arr)
+            if arr.dtype != np.uint8 and arr.dtype != np.dtype(spec.dtype):
+                arr = arr.astype(spec.dtype)
+            return arr
+
+        if isinstance(self.input_spec, dict):
+            array = {name: stage_cast(array[name], spec)
+                     for name, spec in self.input_spec.items()}
+        else:
+            array = stage_cast(array, self.input_spec)
         fn = self.jitted(mesh=mesh)
         multiple = 1
         if mesh is not None:
@@ -339,6 +375,10 @@ class ModelFunction:
         return self.apply_fn(self.variables, x)
 
     def __repr__(self) -> str:
+        if isinstance(self.input_spec, dict):
+            inputs = ", ".join(
+                f"{k}={s.shape} {s.dtype}" for k, s in self.input_spec.items())
+            return f"ModelFunction({self.name}, inputs=({inputs}))"
         return (f"ModelFunction({self.name}, input={self.input_spec.shape} "
                 f"{self.input_spec.dtype})")
 

@@ -227,10 +227,28 @@ def _pil_decode(data_or_path, target_size=None) -> Optional[np.ndarray]:
         return None
 
 
-def decodeImageBytes(data: bytes, target_size=None) -> Optional[np.ndarray]:
-    """Decode compressed image bytes → HWC uint8 array (None on failure)."""
+def decodeImageBytes(data: bytes, target_size=None,
+                     channels: Optional[int] = None) -> Optional[np.ndarray]:
+    """Decode compressed image bytes → HWC uint8 array (None on failure).
+
+    ``channels=None`` preserves the source's own channel count (grayscale
+    stays 1-channel, like Spark's ImageSchema reader); pass 3 to force RGB
+    — the model-staging contract, so the per-row path matches the batch
+    decoder's output for the same input (ADVICE r2 consistency fix).
+    """
     from sparkdl_tpu.native import loader as native_loader
 
+    if channels is not None:
+        if target_size is not None:
+            return decodeImageBytesBatch([data], target_size,
+                                         channels=channels)[0]
+        # no target size: native decode (fast path, GIL released)
+        # preserves channels; coerce after
+        if native_loader.available():
+            arr = native_loader.decode(data, target_size=None)
+            if arr is not None:
+                return forceChannels(arr, channels)
+        return _pil_decode_channels(data, None, channels)
     if native_loader.available():
         arr = native_loader.decode(data, target_size=target_size)
         if arr is not None:
@@ -248,7 +266,8 @@ def stripFileScheme(uri: str) -> str:
     return uri
 
 
-def decodeImageFile(path: str, target_size=None) -> Optional[np.ndarray]:
+def decodeImageFile(path: str, target_size=None,
+                    channels: Optional[int] = None) -> Optional[np.ndarray]:
     """Decode an image file URI → HWC uint8 array (None on failure)."""
     path = stripFileScheme(path)
     try:
@@ -256,7 +275,7 @@ def decodeImageFile(path: str, target_size=None) -> Optional[np.ndarray]:
             data = f.read()
     except OSError:
         return None
-    return decodeImageBytes(data, target_size=target_size)
+    return decodeImageBytes(data, target_size=target_size, channels=channels)
 
 
 def decodeImageBytesBatch(blobs: Sequence[Optional[bytes]],
@@ -289,17 +308,48 @@ def decodeImageBytesBatch(blobs: Sequence[Optional[bytes]],
     return out
 
 
+_PIL_MODE_BY_CHANNELS = {1: "L", 3: "RGB", 4: "RGBA"}
+
+
+def forceChannels(arr: np.ndarray, channels: int) -> np.ndarray:
+    """Coerce an HWC uint8 array to a channel count, PIL-convert semantics
+    (L→RGB replicates, RGBA→RGB drops alpha, RGB→L is ITU-R 601 luma)."""
+    have = arr.shape[2]
+    if have == channels:
+        return arr
+    if channels == 3:
+        if have == 1:
+            return np.repeat(arr, 3, axis=2)
+        if have == 4:
+            return np.ascontiguousarray(arr[:, :, :3])
+    if channels == 1 and have in (3, 4):
+        luma = (arr[:, :, 0] * 0.299 + arr[:, :, 1] * 0.587
+                + arr[:, :, 2] * 0.114)
+        return luma.astype(np.uint8)[:, :, None]
+    if channels == 4 and have == 3:
+        alpha = np.full(arr.shape[:2] + (1,), 255, dtype=np.uint8)
+        return np.concatenate([arr, alpha], axis=2)
+    raise ValueError(f"Cannot coerce {have}-channel image to {channels}")
+
+
 def _pil_decode_channels(data: bytes, target_size, channels: int
                          ) -> Optional[np.ndarray]:
     """PIL decode forced to a fixed channel count (the batch-staging
-    contract: every row must match the native decoder's RGB output)."""
+    contract: every row must match the native decoder's output channels).
+    Supported: 1 (grayscale), 3 (RGB), 4 (RGBA); others raise."""
     from io import BytesIO
 
     from PIL import Image
 
     try:
+        mode = _PIL_MODE_BY_CHANNELS[channels]
+    except KeyError:
+        raise ValueError(
+            f"Unsupported channel count {channels}; "
+            f"supported: {sorted(_PIL_MODE_BY_CHANNELS)}") from None
+    try:
         img = Image.open(BytesIO(data))
-        img = img.convert("RGB" if channels == 3 else "L")
+        img = img.convert(mode)
         if target_size is not None:
             img = img.resize((target_size[1], target_size[0]), Image.BILINEAR)
         arr = np.asarray(img)

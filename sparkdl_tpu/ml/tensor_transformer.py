@@ -17,7 +17,8 @@ import pyarrow as pa
 from sparkdl_tpu.engine.dataframe import column_to_numpy, fixed_size_list_array
 from sparkdl_tpu.ml.base import Transformer
 from sparkdl_tpu.ml.persistence import ModelFunctionPersistence
-from sparkdl_tpu.param.base import keyword_only
+from sparkdl_tpu.param.base import Param, keyword_only
+from sparkdl_tpu.param.converters import SparkDLTypeConverters
 from sparkdl_tpu.param.shared_params import (
     HasBatchSize,
     HasInputCol,
@@ -25,6 +26,13 @@ from sparkdl_tpu.param.shared_params import (
     HasModelFunction,
     HasOutputCol,
 )
+
+
+def _append_column(batch: pa.RecordBatch, name: str, arr: pa.Array
+                   ) -> pa.RecordBatch:
+    cols = [batch.column(i) for i in range(batch.num_columns)] + [arr]
+    schema = batch.schema.append(pa.field(name, arr.type))
+    return pa.RecordBatch.from_arrays(cols, schema=schema)
 
 
 def column_to_block(column: pa.Array, element_shape) -> np.ndarray:
@@ -50,13 +58,32 @@ def column_to_block(column: pa.Array, element_shape) -> np.ndarray:
 class TPUTransformer(Transformer, HasInputCol, HasOutputCol,
                      HasModelFunction, HasBatchSize, HasMesh,
                      ModelFunctionPersistence):
-    """Apply a ModelFunction to a numeric column, emitting list<float32>."""
+    """Apply a ModelFunction to numeric columns, emitting list<float32>.
+
+    Single-IO: ``inputCol``/``outputCol``. Multi-IO (the reference
+    ``TFTransformer``'s tensor↔column maps, SURVEY.md §2.1): a model whose
+    ``input_spec`` is a ``{input-name: TensorSpec}`` dict takes
+    ``inputMapping={column: input-name}`` and emits one column per entry of
+    ``outputMapping={output-name: column}`` from its dict output.
+    """
+
+    inputMapping = Param(
+        "TPUTransformer", "inputMapping",
+        "{column-name: model-input-name} for multi-input models",
+        typeConverter=SparkDLTypeConverters.asColumnToInputMap)
+    outputMapping = Param(
+        "TPUTransformer", "outputMapping",
+        "{model-output-name: column-name} for multi-output models",
+        typeConverter=SparkDLTypeConverters.asOutputToColumnMap)
 
     _persist_name = "tpu_transformer"
+    _persist_skip = ("mesh",)
 
     @keyword_only
     def __init__(self, *, inputCol: Optional[str] = None,
                  outputCol: Optional[str] = None,
+                 inputMapping: Optional[dict] = None,
+                 outputMapping: Optional[dict] = None,
                  modelFunction=None,
                  batchSize: int = 64,
                  mesh=None) -> None:
@@ -68,16 +95,34 @@ class TPUTransformer(Transformer, HasInputCol, HasOutputCol,
     @keyword_only
     def setParams(self, *, inputCol: Optional[str] = None,
                   outputCol: Optional[str] = None,
+                  inputMapping: Optional[dict] = None,
+                  outputMapping: Optional[dict] = None,
                   modelFunction=None,
                   batchSize: int = 64,
                   mesh=None) -> "TPUTransformer":
         return self._set(**self._input_kwargs)
+
+    def setInputMapping(self, value: dict) -> "TPUTransformer":
+        return self._set(inputMapping=value)
+
+    def getInputMapping(self) -> Optional[dict]:
+        return (self.getOrDefault(self.inputMapping)
+                if self.isDefined(self.inputMapping) else None)
+
+    def setOutputMapping(self, value: dict) -> "TPUTransformer":
+        return self._set(outputMapping=value)
+
+    def getOutputMapping(self) -> Optional[dict]:
+        return (self.getOrDefault(self.outputMapping)
+                if self.isDefined(self.outputMapping) else None)
 
 
     def _transform(self, dataset):
         model = self.getModelFunction()
         if model is None:
             raise ValueError("modelFunction must be set")
+        if isinstance(model.input_spec, dict) or self.getInputMapping():
+            return self._transform_multi(dataset, model)
         input_col = self.getInputCol()
         output_col = self.getOutputCol()
         batch_size = self.getBatchSize()
@@ -98,3 +143,62 @@ class TPUTransformer(Transformer, HasInputCol, HasOutputCol,
 
         return dataset.withColumnBatch(output_col, apply_partition,
                                        outputType=pa.list_(pa.float32()))
+
+    def _transform_multi(self, dataset, model):
+        """Column↔named-IO mapping path for dict-spec models."""
+        in_map = self.getInputMapping()
+        out_map = self.getOutputMapping()
+        if not isinstance(model.input_spec, dict):
+            raise ValueError(
+                "inputMapping requires a model with a dict input_spec")
+        if not in_map:
+            raise ValueError(
+                "multi-input model requires inputMapping={column: input}")
+        if not out_map:
+            raise ValueError(
+                "multi-input model requires outputMapping={output: column}")
+        missing = set(model.input_spec) - set(in_map.values())
+        if missing:
+            raise ValueError(f"inputMapping covers no column for model "
+                             f"inputs {sorted(missing)}")
+        for col in in_map:
+            if col not in dataset.columns:
+                raise KeyError(f"No such column: {col!r}")
+        batch_size = self.getBatchSize()
+        mesh = self.resolveMesh()
+        out_cols = list(out_map.items())  # [(output-name, column)]
+
+        def apply_partition(batch: pa.RecordBatch) -> pa.RecordBatch:
+            n = batch.num_rows
+            if n == 0:
+                out = batch
+                for _name, col in out_cols:
+                    out = _append_column(
+                        out, col, pa.array([], type=pa.list_(pa.float32())))
+                return out
+            blocks = {}
+            for col, input_name in in_map.items():
+                spec = model.input_spec[input_name]
+                arr = batch.column(batch.schema.get_field_index(col))
+                blocks[input_name] = column_to_block(arr, spec.element_shape)
+            outs = model.apply_batch(blocks, batch_size=batch_size, mesh=mesh)
+            if not isinstance(outs, dict):
+                raise ValueError(
+                    "outputMapping requires the model to return a "
+                    f"{{output-name: array}} dict, got {type(outs).__name__}")
+            result = batch
+            for name, col in out_cols:
+                if name not in outs:
+                    raise KeyError(
+                        f"model returned no output named {name!r}; has "
+                        f"{sorted(outs)}")
+                flat = np.asarray(outs[name], dtype=np.float32).reshape(n, -1)
+                result = _append_column(
+                    result, col,
+                    fixed_size_list_array(flat).cast(pa.list_(pa.float32())))
+            return result
+
+        schema = dataset.schema
+        for _name, col in out_cols:
+            schema = schema.append(pa.field(col, pa.list_(pa.float32())))
+        return dataset.mapPartitions(apply_partition, schema=schema)

@@ -78,7 +78,8 @@ class SparkDLTypeConverters:
     Where the reference validated ``{tf.Tensor-name: column-name}`` dicts for
     ``TFTransformer`` (upstream ``SparkDLTypeConverters.asColumnToTensorNameMap``
     etc.), the rebuild validates ``{model-input-name: column-name}`` maps for
-    :class:`~sparkdl_tpu.transformers.tensor.TensorTransformer`.
+    :class:`sparkdl_tpu.ml.tensor_transformer.TPUTransformer`'s multi-IO
+    ``inputMapping``/``outputMapping`` params.
     """
 
     @staticmethod

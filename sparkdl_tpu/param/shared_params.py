@@ -211,7 +211,11 @@ class CanLoadImage(Params):
             if loader is not None:
                 arr = loader(uri)
             else:
-                arr = imageIO.decodeImageFile(uri, target_size=target_size)
+                # channels=3 keeps per-row output identical to the batch
+                # decoder's forced-RGB contract (ADVICE r2: grayscale must
+                # not change channel count depending on which path ran)
+                arr = imageIO.decodeImageFile(uri, target_size=target_size,
+                                              channels=3)
             if arr is None:
                 return None
             return imageIO.imageArrayToStruct(arr)

@@ -57,8 +57,15 @@ _EPS = 1e-7
 def _align_ranks(outputs, labels):
     """keras ``squeeze_or_expand_dimensions``: make elementwise losses see
     matching ranks so (N,) labels vs (N, 1) sigmoid heads never broadcast
-    to (N, N)."""
+    to (N, N). (N, k>1) labels against a 1-unit head raise instead of
+    silently broadcasting (ADVICE r2: one-hot labels into sigmoid BCE)."""
     labels = jnp.asarray(labels)
+    if (outputs.ndim == labels.ndim and outputs.shape[-1] == 1
+            and labels.shape[-1] > 1):
+        raise ValueError(
+            f"labels with trailing dim {labels.shape[-1]} cannot feed a "
+            "1-unit (sigmoid) head; pass (N,) 0/1 labels or argmax the "
+            "one-hot")
     if labels.ndim == outputs.ndim - 1 and outputs.shape[-1] == 1:
         labels = labels[..., None]
     elif outputs.ndim == labels.ndim - 1 and labels.shape[-1] == 1:
@@ -152,7 +159,11 @@ def accuracy_metric(outputs, labels, from_logits: bool = False) -> jax.Array:
         threshold = 0.0 if from_logits else 0.5
         pred = (outputs[..., 0] >= threshold).astype(jnp.float32)
         if labels.ndim == outputs.ndim:
-            labels = labels[..., 0]
+            # (N,1) labels squeeze; (N,k) one-hot argmaxes to class ids —
+            # labels[...,0] would be the class-0 indicator, INVERTING the
+            # metric (ADVICE r2)
+            labels = (labels[..., 0] if labels.shape[-1] == 1
+                      else jnp.argmax(labels, axis=-1))
         return jnp.mean((pred == labels.astype(jnp.float32))
                         .astype(jnp.float32))
     pred = jnp.argmax(outputs, axis=-1)

@@ -29,6 +29,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# Debug hardening (SURVEY.md §5.2): SPARKDL_DEBUG=1 runs the whole suite
+# under jax_debug_nans + tracer-leak checking (slow: op-by-op; off by
+# default). The NaN regression test enables it locally either way.
+if os.environ.get("SPARKDL_DEBUG", "") not in ("", "0"):
+    jax.config.update("jax_debug_nans", True)
+    jax.config.update("jax_check_tracer_leaks", True)
+
 
 @pytest.fixture
 def rng():

@@ -1,0 +1,64 @@
+"""Debug hardening (SURVEY.md §5.2): NaN and shape sanitizer behavior."""
+
+import numpy as np
+import pytest
+
+from sparkdl_tpu.core.debug import debug_mode
+
+
+def test_debug_mode_catches_nan_loss():
+    """A NaN produced inside a jitted train step raises at the producing op
+    under debug_mode instead of silently poisoning the metrics."""
+    import flax.linen as nn
+    import jax
+
+    from sparkdl_tpu.train import Trainer
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            return nn.Dense(2)(x)
+
+    module = Net()
+    variables = module.init(jax.random.PRNGKey(0), np.zeros((1, 3), np.float32))
+
+    def nan_loss(outputs, labels):
+        return jax.numpy.log(-jax.numpy.ones(())) + outputs.sum() * 0.0
+
+    trainer, state = Trainer.from_flax(module, variables, loss=nan_loss,
+                                       optimizer="sgd", learning_rate=0.1)
+    x = np.ones((4, 3), np.float32)
+    y = np.zeros((4, 2), np.float32)
+    with debug_mode():
+        with pytest.raises(FloatingPointError):
+            trainer.fit(state, [(x, y)], epochs=1)
+    # outside debug mode the same step completes (loss is NaN, not an error)
+    state2 = trainer.fit(state, [(x, y)], epochs=1)
+    assert int(state2.step) == 1
+
+
+def test_debug_mode_restores_config():
+    import jax
+
+    before = jax.config.jax_debug_nans
+    with debug_mode():
+        assert jax.config.jax_debug_nans
+    assert jax.config.jax_debug_nans == before
+
+
+def test_binary_head_one_hot_labels_raise():
+    """(N,2) one-hot labels into a 1-unit sigmoid head must raise, not
+    silently broadcast (ADVICE r2)."""
+    import jax.numpy as jnp
+
+    from sparkdl_tpu.train.optimizers import accuracy_metric, make_loss
+
+    loss = make_loss("binary_crossentropy")
+    probs = jnp.full((4, 1), 0.9)
+    onehot = jnp.eye(2)[jnp.array([1, 0, 1, 1])]
+    with pytest.raises(ValueError, match="1-unit"):
+        loss(probs, onehot)
+    # accuracy with one-hot labels argmaxes to class ids (not the class-0
+    # indicator, which would invert the metric)
+    acc = accuracy_metric(probs, onehot)
+    np.testing.assert_allclose(float(acc), 0.75)

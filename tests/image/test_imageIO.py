@@ -209,3 +209,47 @@ def test_resize_batch_float32_preserves_dtype(rng):
     batch = rng.uniform(0, 1, size=(3, 16, 12, 3)).astype(np.float32)
     out = imageIO.resizeBatchArray(batch, (8, 10))
     assert out.shape == (3, 8, 10, 3) and out.dtype == np.float32
+
+
+def test_grayscale_channel_consistency_batch_vs_per_row(tmp_path):
+    """ADVICE r2: the same grayscale input must yield the same channel
+    count whether the batch decoder or the per-row path ran."""
+    from PIL import Image
+
+    rng = np.random.default_rng(3)
+    p = tmp_path / "gray.png"
+    Image.fromarray(rng.integers(0, 255, size=(20, 16), dtype=np.uint8),
+                    mode="L").save(p)
+    per_row = imageIO.decodeImageFile(str(p), target_size=(10, 8), channels=3)
+    batch = imageIO.decodeImageFilesBatch([str(p)], target_size=(10, 8))[0]
+    assert per_row.shape == batch.shape == (10, 8, 3)
+    np.testing.assert_array_equal(per_row, batch)
+    # channels=None preserves the source's own channel count
+    preserved = imageIO.decodeImageFile(str(p))
+    assert preserved.shape[2] == 1
+
+
+def test_pil_decode_channels_rgba_and_invalid(tmp_path):
+    from io import BytesIO
+
+    from PIL import Image
+
+    from sparkdl_tpu.image.imageIO import _pil_decode_channels
+
+    rng = np.random.default_rng(4)
+    buf = BytesIO()
+    Image.fromarray(rng.integers(0, 255, size=(6, 5, 4), dtype=np.uint8),
+                    mode="RGBA").save(buf, format="PNG")
+    out = _pil_decode_channels(buf.getvalue(), (6, 5), channels=4)
+    assert out.shape == (6, 5, 4)
+    with pytest.raises(ValueError, match="channel count"):
+        _pil_decode_channels(buf.getvalue(), (6, 5), channels=2)
+
+
+def test_bucket_size_respects_multiple_above_batch_size():
+    from sparkdl_tpu.core.batching import bucket_size
+
+    # ADVICE r2 footgun: n > batch_size escape must still honor `multiple`
+    assert bucket_size(10, 8, multiple=4) == 12
+    assert bucket_size(10, 8) == 10
+    assert bucket_size(3, 8, multiple=8) == 8

@@ -162,3 +162,29 @@ def test_save_with_custom_image_loader_raises(tmp_path):
                             imageLoader=lambda uri: None)
     with pytest.raises(ValueError, match="imageLoader"):
         m.save(str(tmp_path / "x"))
+
+
+def test_multi_io_transformer_roundtrip(rng, tmp_path):
+    """Dict-input models persist too: export carries one shared symbolic
+    batch dim across inputs; the reloaded stage maps the same columns."""
+    from sparkdl_tpu.core.model_function import ModelFunction, TensorSpec
+    from sparkdl_tpu.ml import TPUTransformer
+
+    def apply_fn(vs, x):
+        return {"sum": x["a"] + x["b"]}
+
+    spec = {"a": TensorSpec((None, 4), "float32"),
+            "b": TensorSpec((None, 4), "float32")}
+    mf = ModelFunction.fromFunction(apply_fn, None, spec, name="two_in")
+    t = TPUTransformer(modelFunction=mf,
+                       inputMapping={"colA": "a", "colB": "b"},
+                       outputMapping={"sum": "s"}, batchSize=4)
+    a = rng.normal(size=(5, 4)).astype(np.float32)
+    b = rng.normal(size=(5, 4)).astype(np.float32)
+    df = DataFrame.fromColumns({"colA": a, "colB": b})
+    want = _vectors(t.transform(df), "s")
+    t.save(str(tmp_path / "mio"))
+    t2 = load(str(tmp_path / "mio"))
+    assert isinstance(t2.getModelFunction().input_spec, dict)
+    got = _vectors(t2.transform(df), "s")
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)

@@ -188,3 +188,70 @@ def test_tensor_transformer_empty_partition():
 def test_reference_alias_names():
     assert TFImageTransformer is TPUImageTransformer
     assert TFTransformer is TPUTransformer
+
+
+def _two_io_model():
+    """2-input / 2-output ModelFunction with a dict input spec."""
+    from sparkdl_tpu.core.model_function import ModelFunction, TensorSpec
+
+    def apply_fn(vs, x):
+        a, b = x["a"], x["b"]
+        return {"sum": a + b, "prod_mean": (a * b).mean(axis=1, keepdims=True)}
+
+    spec = {"a": TensorSpec((None, 4), "float32"),
+            "b": TensorSpec((None, 4), "float32")}
+    return ModelFunction.fromFunction(apply_fn, None, spec, name="two_io")
+
+
+def test_tensor_transformer_multi_io(rng):
+    mf = _two_io_model()
+    a = rng.normal(size=(11, 4)).astype(np.float32)
+    b = rng.normal(size=(11, 4)).astype(np.float32)
+    df = DataFrame.fromColumns({"colA": a, "colB": b}, numPartitions=3)
+    t = TPUTransformer(modelFunction=mf,
+                       inputMapping={"colA": "a", "colB": "b"},
+                       outputMapping={"sum": "s", "prod_mean": "pm"},
+                       batchSize=4)
+    out = t.transform(df)
+    rows = out.collect()
+    assert set(out.columns) == {"colA", "colB", "s", "pm"}
+    got_s = np.array([r["s"] for r in rows], dtype=np.float32)
+    got_pm = np.array([r["pm"] for r in rows], dtype=np.float32)
+    np.testing.assert_allclose(got_s, a + b, rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(got_pm, (a * b).mean(axis=1, keepdims=True),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_tensor_transformer_multi_io_mesh_matches_single(rng):
+    from sparkdl_tpu.core.mesh import MeshConfig, make_mesh
+
+    mf = _two_io_model()
+    a = rng.normal(size=(13, 4)).astype(np.float32)
+    b = rng.normal(size=(13, 4)).astype(np.float32)
+    df = DataFrame.fromColumns({"colA": a, "colB": b}, numPartitions=2)
+
+    def run(mesh):
+        t = TPUTransformer(modelFunction=mf,
+                           inputMapping={"colA": "a", "colB": "b"},
+                           outputMapping={"sum": "s"}, batchSize=8, mesh=mesh)
+        return np.array([r["s"] for r in t.transform(df).collect()],
+                        dtype=np.float32)
+
+    mesh8 = make_mesh(MeshConfig(data=8))
+    np.testing.assert_allclose(run(mesh8), run(None), rtol=1e-6, atol=1e-6)
+
+
+def test_tensor_transformer_multi_io_validation(rng):
+    mf = _two_io_model()
+    df = DataFrame.fromColumns({"colA": rng.normal(size=(3, 4)).astype(np.float32)})
+    with pytest.raises(ValueError, match="outputMapping"):
+        TPUTransformer(modelFunction=mf,
+                       inputMapping={"colA": "a"}).transform(df)
+    with pytest.raises(ValueError, match="inputMapping covers no column"):
+        TPUTransformer(modelFunction=mf,
+                       inputMapping={"colA": "a"},
+                       outputMapping={"sum": "s"}).transform(df)
+    with pytest.raises(KeyError, match="colB"):
+        TPUTransformer(modelFunction=mf,
+                       inputMapping={"colA": "a", "colB": "b"},
+                       outputMapping={"sum": "s"}).transform(df)
