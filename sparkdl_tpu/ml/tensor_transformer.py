@@ -14,7 +14,11 @@ from typing import Optional
 import numpy as np
 import pyarrow as pa
 
-from sparkdl_tpu.engine.dataframe import column_to_numpy, fixed_size_list_array
+from sparkdl_tpu.engine.dataframe import (
+    _set_column,
+    column_to_numpy,
+    fixed_size_list_array,
+)
 from sparkdl_tpu.ml.base import Transformer
 from sparkdl_tpu.ml.persistence import ModelFunctionPersistence
 from sparkdl_tpu.param.base import Param, keyword_only
@@ -26,13 +30,6 @@ from sparkdl_tpu.param.shared_params import (
     HasModelFunction,
     HasOutputCol,
 )
-
-
-def _append_column(batch: pa.RecordBatch, name: str, arr: pa.Array
-                   ) -> pa.RecordBatch:
-    cols = [batch.column(i) for i in range(batch.num_columns)] + [arr]
-    schema = batch.schema.append(pa.field(name, arr.type))
-    return pa.RecordBatch.from_arrays(cols, schema=schema)
 
 
 def column_to_block(column: pa.Array, element_shape) -> np.ndarray:
@@ -161,6 +158,11 @@ class TPUTransformer(Transformer, HasInputCol, HasOutputCol,
         if missing:
             raise ValueError(f"inputMapping covers no column for model "
                              f"inputs {sorted(missing)}")
+        unknown = set(in_map.values()) - set(model.input_spec)
+        if unknown:
+            raise ValueError(
+                f"inputMapping references unknown model inputs "
+                f"{sorted(unknown)}; model has {sorted(model.input_spec)}")
         for col in in_map:
             if col not in dataset.columns:
                 raise KeyError(f"No such column: {col!r}")
@@ -173,7 +175,7 @@ class TPUTransformer(Transformer, HasInputCol, HasOutputCol,
             if n == 0:
                 out = batch
                 for _name, col in out_cols:
-                    out = _append_column(
+                    out = _set_column(
                         out, col, pa.array([], type=pa.list_(pa.float32())))
                 return out
             blocks = {}
@@ -193,7 +195,7 @@ class TPUTransformer(Transformer, HasInputCol, HasOutputCol,
                         f"model returned no output named {name!r}; has "
                         f"{sorted(outs)}")
                 flat = np.asarray(outs[name], dtype=np.float32).reshape(n, -1)
-                result = _append_column(
+                result = _set_column(
                     result, col,
                     fixed_size_list_array(flat).cast(pa.list_(pa.float32())))
             return result

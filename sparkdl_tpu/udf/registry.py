@@ -15,15 +15,29 @@ import numpy as np
 
 
 class ColumnUDF:
-    """A named column operator: ``apply(df, input_col, output_col)``."""
+    """A named column operator: ``apply(df, input_cols, output_col)``.
 
-    def __init__(self, name: str, apply_fn: Callable, kind: str) -> None:
+    ``arity``: number of input columns the UDF consumes; model UDFs are
+    unary (one image/tensor column), plain row functions take any arity.
+    """
+
+    def __init__(self, name: str, apply_fn: Callable, kind: str,
+                 arity: Optional[int] = 1) -> None:
         self.name = name
         self._apply_fn = apply_fn
         self.kind = kind
+        self.arity = arity
 
-    def apply(self, df, input_col: str, output_col: str):
-        return self._apply_fn(df, input_col, output_col)
+    def apply(self, df, input_cols, output_col: str):
+        if isinstance(input_cols, str):
+            input_cols = [input_cols]
+        if self.arity is not None and len(input_cols) != self.arity:
+            raise ValueError(
+                f"UDF {self.name!r} takes {self.arity} argument(s), "
+                f"got {len(input_cols)}")
+        if self.arity == 1:
+            return self._apply_fn(df, input_cols[0], output_col)
+        return self._apply_fn(df, input_cols, output_col)
 
     def __repr__(self) -> str:
         return f"ColumnUDF({self.name!r}, kind={self.kind!r})"
@@ -68,15 +82,23 @@ class UDFRegistry:
 udf_registry = UDFRegistry()
 
 
-def registerUDF(name: str, fn: Callable, outputType=None,
+def registerUDF(name: str, fn: Callable, outputType=None, arity: int = 1,
                 registry: Optional[UDFRegistry] = None) -> ColumnUDF:
-    """Register a plain row function ``value -> value`` under ``name``."""
+    """Register a plain row function ``(*values) -> value`` under ``name``.
 
-    def apply_fn(df, input_col, output_col):
-        return df.withColumn(output_col, fn, inputCols=[input_col],
+    ``arity``: how many columns the function consumes (``selectExpr``
+    passes that many arguments).
+    """
+
+    def apply_fn(df, input_cols, output_col):
+        if isinstance(input_cols, str):
+            input_cols = [input_cols]
+        return df.withColumn(output_col, fn, inputCols=list(input_cols),
                              outputType=outputType)
 
-    return (registry or udf_registry).register(ColumnUDF(name, apply_fn, "row"))
+    return (registry or udf_registry).register(
+        ColumnUDF(name, apply_fn, "row",
+                  arity=None if arity is None else int(arity)))
 
 
 def registerTensorUDF(name: str, modelFunction, batchSize: int = 64,

@@ -186,3 +186,47 @@ def test_limit_materializes_only_needed_partitions():
     big = DataFrame.fromRows([{"x": i} for i in range(100)], numPartitions=10)
     assert big.withColumnBatch("y", op, pa.int64()).limit(5).count() == 5
     assert calls["n"] == 1
+
+
+def test_select_expr_star_literals_aliases(rng):
+    df = DataFrame.fromColumns({"a": np.arange(4, dtype=np.int64),
+                                "b": np.arange(4, dtype=np.float32)})
+    out = df.selectExpr("*", "7 as seven", "'x' as tag", "a as a2")
+    rows = out.collect()
+    assert out.columns == ["a", "b", "seven", "tag", "a2"]
+    assert rows[0]["seven"] == 7 and rows[0]["tag"] == "x"
+    assert [r["a2"] for r in rows] == [0, 1, 2, 3]
+
+
+def test_select_expr_nested_and_multi_arg_udfs(rng):
+    from sparkdl_tpu.udf import registerUDF, udf_registry
+
+    registerUDF("sq_test", lambda v: v * v)
+    registerUDF("addc_test", lambda a, b: a + b, arity=2)
+    try:
+        df = DataFrame.fromColumns({"x": np.arange(4, dtype=np.int64),
+                                    "y": np.arange(4, dtype=np.int64)})
+        out = df.selectExpr("addc_test(sq_test(x), y) as z").collect()
+        assert [r["z"] for r in out] == [0, 2, 6, 12]
+        # default name is the trimmed expression text
+        out2 = df.selectExpr("sq_test( x )")
+        assert out2.columns == ["sq_test( x )"]
+    finally:
+        udf_registry.unregister("sq_test")
+        udf_registry.unregister("addc_test")
+
+
+def test_select_expr_arity_and_parse_errors(rng):
+    from sparkdl_tpu.udf import registerUDF, udf_registry
+
+    registerUDF("one_arg_test", lambda v: v)
+    try:
+        df = DataFrame.fromColumns({"x": np.arange(3, dtype=np.int64)})
+        with pytest.raises(ValueError, match="argument"):
+            df.selectExpr("one_arg_test(x, x)")
+        with pytest.raises(ValueError, match="Cannot tokenize|Unexpected|Trailing"):
+            df.selectExpr("x + 1")
+        with pytest.raises(KeyError, match="nope"):
+            df.selectExpr("nope")
+    finally:
+        udf_registry.unregister("one_arg_test")

@@ -95,5 +95,5 @@ def test_select_expr_plain_and_alias():
                                 "y": np.array([3.0, 4.0])})
     out = df.selectExpr("y as z", "x").collect()
     assert list(out[0].keys()) == ["z", "x"]
-    with pytest.raises(ValueError, match="parse"):
+    with pytest.raises(ValueError, match="tokenize"):
         df.selectExpr("sum(x) + 1")
