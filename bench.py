@@ -202,7 +202,7 @@ def bench_udf(n_rows=512):
     return n_rows / best
 
 
-def bench_train_step(model_name, batch_size, mesh=None):
+def bench_train_step(model_name, batch_size, mesh=None, compute_dtype=None):
     """Step time via in-order stream: time K steps, barrier on final loss."""
     import jax
 
@@ -220,7 +220,8 @@ def bench_train_step(model_name, batch_size, mesh=None):
     variables = jax.jit(module.init)(jax.random.PRNGKey(0),
                                      jnp.zeros((1, h, w, 3), jnp.float32))
     trainer, state = Trainer.from_flax(module, variables, optimizer="sgd",
-                                       learning_rate=0.01, mesh=mesh)
+                                       learning_rate=0.01, mesh=mesh,
+                                       compute_dtype=compute_dtype)
     step = trainer.make_train_step(donate=False)
     xd, yd = jax.device_put(x), jax.device_put(y)
     state, m = step(state, xd, yd)
@@ -265,11 +266,18 @@ def main():
             emit("SQL UDF rows/sec (InceptionV3 via selectExpr)",
                  rps, "rows/sec")
             st = bench_train_step("MobileNetV2", 64)
+            st16 = bench_train_step("MobileNetV2", 64,
+                                    compute_dtype="bfloat16")
             emit("fine-tune step time (MobileNetV2 b64)", st * 1e3, "ms/step",
-                 images_per_sec=round(64 / st, 2))
+                 images_per_sec=round(64 / st, 2),
+                 mixed_precision_ms=round(st16 * 1e3, 2),
+                 mixed_precision_images_per_sec=round(64 / st16, 2))
             st = bench_train_step("ResNet50", 64)
+            st16 = bench_train_step("ResNet50", 64, compute_dtype="bfloat16")
             emit("DP train step time (ResNet50 b64, 1 chip)", st * 1e3,
-                 "ms/step", images_per_sec=round(64 / st, 2))
+                 "ms/step", images_per_sec=round(64 / st, 2),
+                 mixed_precision_ms=round(st16 * 1e3, 2),
+                 mixed_precision_images_per_sec=round(64 / st16, 2))
 
         ips, spread, mfu, runs = bench_headline()
         emit("images/sec/chip (InceptionV3 featurize)", ips,

@@ -51,7 +51,7 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
     kerasFitParams = Param(
         "KerasImageFileEstimator", "kerasFitParams",
         "fit options: {'epochs': int, 'batch_size': int, "
-        "'learning_rate': float, 'shuffle': bool, 'seed': int}",
+        "'learning_rate': float, 'shuffle': bool, 'seed': int, 'streaming': bool, 'mixed_precision': bool}",
         typeConverter=TypeConverters.identity)
 
     @keyword_only
@@ -218,7 +218,9 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
             self._label_preparer(mf))
         trainer, state = Trainer.from_model_function(
             mf, loss=self.getKerasLoss(), optimizer=self.getKerasOptimizer(),
-            learning_rate=lr, mesh=mesh)
+            learning_rate=lr, mesh=mesh,
+            compute_dtype="bfloat16" if fit_params.get("mixed_precision")
+            else None)
         state = trainer.fit(state, stream, epochs=epochs)
         if stream.batches_last_epoch == 0:
             raise ValueError("No decodable training images")
@@ -276,7 +278,9 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
 
         trainer, state = Trainer.from_model_function(
             mf, loss=self.getKerasLoss(), optimizer=self.getKerasOptimizer(),
-            learning_rate=lr, mesh=mesh)
+            learning_rate=lr, mesh=mesh,
+            compute_dtype="bfloat16" if fit_params.get("mixed_precision")
+            else None)
         state = trainer.fit(state, batches, epochs=epochs)
         return self._wrap_trained(mf, state)
 

@@ -414,26 +414,68 @@ def _collect_weights_and_mask(model):
     return weights, mask
 
 
+def _io_name(tensor) -> str:
+    """Stable IO key for a model boundary tensor: the owning layer's name
+    (``keras.Input(name="a")`` → InputLayer "a"; outputs take the producing
+    layer's name — the upstream ``TFTransformer`` mapped by the analogous
+    TF tensor names, SURVEY.md §2.1)."""
+    history = getattr(tensor, "_keras_history", None)
+    op = getattr(history, "operation", None) if history is not None else None
+    if op is not None:
+        return op.name
+    return getattr(tensor, "name", "tensor")
+
+
 def keras_to_model_function(model, name: str = None) -> ModelFunction:
     """Ingest a built Keras model (Sequential or functional) as a
-    ModelFunction; the layer DAG becomes one jax-traceable pure function."""
+    ModelFunction; the layer DAG becomes one jax-traceable pure function.
+
+    Multi-input models yield a ``{input-name: TensorSpec}`` dict spec and
+    take a dict of arrays; multi-output models return
+    ``{output-name: array}`` — feeding ``TPUTransformer``'s
+    ``inputMapping``/``outputMapping`` path.
+    """
     if not getattr(model, "built", True):
         raise ValueError("Keras model must be built (call it or pass Input)")
-    if len(model.inputs) != 1:
-        raise ValueError(
-            f"Only single-input models supported, got {len(model.inputs)}")
-    if len(model.outputs) != 1:
-        raise ValueError(
-            f"Only single-output models supported, got {len(model.outputs)}")
 
     steps, out_ids, in_ids = _walk_graph(model)
     weights, mask = _collect_weights_and_mask(model)
-    in_shape = model.inputs[0].shape
-    spec = TensorSpec(tuple(None if d is None else int(d) for d in in_shape),
-                      "float32")
 
-    def apply_fn(vs, x):
-        return _run_steps(steps, {in_ids[0]: x}, vs, out_ids)[0]
+    def spec_of(t) -> TensorSpec:
+        return TensorSpec(
+            tuple(None if d is None else int(d) for d in t.shape), "float32")
+
+    multi_out = len(model.outputs) > 1
+    output_names = [_io_name(t) for t in model.outputs]
+    if len(set(output_names)) != len(output_names):
+        raise ValueError(
+            f"Model output names are not unique ({output_names}); a shared "
+            "layer producing several outputs needs distinct terminal "
+            "layers (e.g. Identity/Activation with names) so outputs can "
+            "be addressed by name")
+
+    if len(model.inputs) == 1:
+        spec = spec_of(model.inputs[0])
+
+        def apply_fn(vs, x):
+            outs = _run_steps(steps, {in_ids[0]: x}, vs, out_ids)
+            if multi_out:
+                return dict(zip(output_names, outs))
+            return outs[0]
+    else:
+        input_names = [_io_name(t) for t in model.inputs]
+        if len(set(input_names)) != len(input_names):
+            raise ValueError(
+                f"Model input names are not unique ({input_names}); name "
+                "your keras.Input layers distinctly")
+        spec = {n: spec_of(t) for n, t in zip(input_names, model.inputs)}
+
+        def apply_fn(vs, x):
+            env = {tid: x[n] for n, tid in zip(input_names, in_ids)}
+            outs = _run_steps(steps, env, vs, out_ids)
+            if multi_out:
+                return dict(zip(output_names, outs))
+            return outs[0]
 
     return ModelFunction(apply_fn, jax.tree.map(jnp.asarray, weights), spec,
                          name=name or model.name, trainable_mask=mask)

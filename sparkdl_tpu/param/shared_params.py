@@ -276,7 +276,13 @@ class HasKerasModel(Params):
         return that
 
     def loadKerasModelAsFunction(self):
-        """Resolve model/modelFile to a ModelFunction (generic ingestion)."""
+        """Resolve model/modelFile to a ModelFunction (generic ingestion).
+
+        Single-IO only at THIS surface: the Keras transformers/estimator
+        bind one input column to one output column. Multi-input/-output
+        models ingest fine via ``keras_to_model_function`` directly and
+        serve through ``TPUTransformer`` ``inputMapping``/``outputMapping``.
+        """
         from sparkdl_tpu.models.convert import load_keras_file
         from sparkdl_tpu.models.keras_ingest import keras_to_model_function
 
@@ -286,7 +292,15 @@ class HasKerasModel(Params):
             if path is None:
                 raise ValueError("set either model or modelFile")
             model = load_keras_file(path)
-        return keras_to_model_function(model)
+        mf = keras_to_model_function(model)
+        if isinstance(mf.input_spec, dict) or len(model.outputs) > 1:
+            raise ValueError(
+                f"{type(self).__name__} binds one input column to one "
+                "output column; this Keras model has "
+                f"{len(model.inputs)} inputs / {len(model.outputs)} "
+                "outputs — use TPUTransformer with inputMapping/"
+                "outputMapping for multi-IO models")
+        return mf
 
     def cachedModelFunction(self):
         """loadKerasModelAsFunction with one ingestion per model value."""

@@ -63,6 +63,11 @@ class Trainer:
     has_model_state: bool = False
     compute_accuracy: bool = True
     accuracy_from_logits: bool = False
+    # Mixed precision (keras mixed_precision parity, TPU-native form):
+    # forward/backward run in this dtype (bf16 keeps f32's exponent range,
+    # so no loss scaling is needed on TPU) while master params, optimizer
+    # state and the update stay float32. None = full precision.
+    compute_dtype: Any = None
 
     # -- constructors --------------------------------------------------------
 
@@ -110,9 +115,19 @@ class Trainer:
         BatchNorm moving stats) are frozen so their gradients through the
         inference-mode forward are never applied.
         """
+        if isinstance(mf.input_spec, dict):
+            raise ValueError(
+                f"Model {mf.name!r} has multiple named inputs; the Trainer "
+                "trains single-input models — serve multi-IO models via "
+                "TPUTransformer inputMapping/outputMapping instead")
 
         def apply_fn(vs, x, train, rngs):
-            return mf.apply_fn(vs["params"], x)
+            out = mf.apply_fn(vs["params"], x)
+            if isinstance(out, dict):
+                raise ValueError(
+                    f"Model {mf.name!r} returns multiple named outputs; "
+                    "the Trainer's loss needs a single output head")
+            return out
 
         tx = make_optimizer(optimizer, learning_rate)
         mask = getattr(mf, "trainable_mask", None)
@@ -156,22 +171,55 @@ class Trainer:
         has_state = self.has_model_state
         want_acc = self.compute_accuracy
         acc_from_logits = self.accuracy_from_logits
+        compute_dtype = (jnp.dtype(self.compute_dtype)
+                         if self.compute_dtype is not None else None)
+
+        def to_compute(tree):
+            return jax.tree.map(
+                lambda a: a.astype(compute_dtype)
+                if jnp.issubdtype(a.dtype, jnp.floating) else a, tree)
+
+        def to_master(tree, like):
+            return jax.tree.map(
+                lambda a, m: a.astype(m.dtype), tree, like)
 
         def step_fn(state: TrainState, x, y):
             rng, step_rng = jax.random.split(state.rng)
             rngs = {"dropout": step_rng}
 
             def compute_loss(params):
-                vs = {"params": params, **state.model_state}
-                res = apply_fn(vs, x, True, rngs)
+                # model_state (e.g. BatchNorm running stats) deliberately
+                # stays f32 under mixed precision: the moving-average
+                # update old*m + batch*(1-m) underflows bf16's 8-bit
+                # mantissa for small increments and the stats would stall
+                # (keras mixed_precision keeps BN state f32 for the same
+                # reason)
+                model_state = state.model_state
+                if compute_dtype is not None:
+                    params = to_compute(params)
+                    xc = to_compute(x)
+                else:
+                    xc = x
+                vs = {"params": params, **model_state}
+                res = apply_fn(vs, xc, True, rngs)
                 if has_state:
                     out, new_model_state = res
                 else:
                     out, new_model_state = res, state.model_state
-                return loss_fn(out, y), (out, new_model_state)
+                # loss in f32 regardless: reductions over many bf16 terms
+                # lose precision
+                return loss_fn(out.astype(jnp.float32), y), (out, new_model_state)
 
             grad_fn = jax.value_and_grad(compute_loss, has_aux=True)
             (loss, (out, new_model_state)), grads = grad_fn(state.params)
+            if compute_dtype is not None:
+                # value_and_grad already returns f32 grads (the cast is in
+                # the graph); this is a defensive no-op. Model-state leaves
+                # a model computes in low precision get restored to master
+                # dtype.
+                grads = to_master(grads, state.params)
+                new_model_state = to_master(new_model_state,
+                                            state.model_state)
             updates, new_opt_state = optimizer.update(grads, state.opt_state,
                                                       state.params)
             new_params = optax.apply_updates(state.params, updates)

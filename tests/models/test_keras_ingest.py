@@ -101,11 +101,17 @@ def test_unsupported_layer_raises_at_ingestion():
         keras_to_model_function(m)
 
 
-def test_multi_output_rejected():
+def test_single_input_multi_output_returns_dict(np_rng):
     inp = keras.Input((4,))
-    m = keras.Model(inp, [layers.Dense(2)(inp), layers.Dense(3)(inp)])
-    with pytest.raises(ValueError, match="single-output"):
-        keras_to_model_function(m)
+    m = keras.Model(inp, [layers.Dense(2, name="h1")(inp),
+                          layers.Dense(3, name="h2")(inp)])
+    mf = keras_to_model_function(m)
+    x = np_rng.normal(size=(5, 4)).astype(np.float32)
+    got = mf.apply_batch(x, batch_size=4)
+    assert set(got) == {"h1", "h2"}
+    w1, w2 = m.predict(x, verbose=0)
+    np.testing.assert_allclose(got["h1"], w1, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(got["h2"], w2, rtol=1e-4, atol=1e-5)
 
 
 def test_channels_first_rejected_at_ingestion():
@@ -171,3 +177,71 @@ def test_shared_bn_with_positive_axis(np_rng):
     m = keras.Model(inp, out)
     x = np_rng.normal(size=(2, 6, 6, 3)).astype(np.float32)
     _check(m, x)
+
+
+def test_multi_input_multi_output_functional_model(rng):
+    """2-input/2-output functional graph ingests to a dict-spec
+    ModelFunction matching keras predict (oracle), and runs through the
+    TPUTransformer inputMapping/outputMapping DataFrame path."""
+    import keras
+    from keras import layers
+
+    a_in = keras.Input((4,), name="a")
+    b_in = keras.Input((6,), name="b")
+    ha = layers.Dense(5, activation="relu", name="da")(a_in)
+    hb = layers.Dense(5, activation="relu", name="db")(b_in)
+    merged = layers.Concatenate(name="cat")([ha, hb])
+    out1 = layers.Dense(3, name="head1")(merged)
+    out2 = layers.Dense(2, activation="softmax", name="head2")(merged)
+    model = keras.Model([a_in, b_in], [out1, out2])
+
+    mf = keras_to_model_function(model)
+    assert isinstance(mf.input_spec, dict)
+    assert set(mf.input_spec) == {"a", "b"}
+
+    a = rng.normal(size=(7, 4)).astype(np.float32)
+    b = rng.normal(size=(7, 6)).astype(np.float32)
+    got = mf.apply_batch({"a": a, "b": b}, batch_size=4)
+    want1, want2 = model.predict([a, b], verbose=0)
+    np.testing.assert_allclose(got["head1"], want1, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(got["head2"], want2, rtol=1e-4, atol=1e-5)
+
+    # DataFrame path
+    from sparkdl_tpu.engine.dataframe import DataFrame
+    from sparkdl_tpu.ml import TPUTransformer
+
+    df = DataFrame.fromColumns({"colA": a, "colB": b}, numPartitions=2)
+    t = TPUTransformer(modelFunction=mf,
+                       inputMapping={"colA": "a", "colB": "b"},
+                       outputMapping={"head1": "o1", "head2": "o2"},
+                       batchSize=4)
+    rows = t.transform(df).collect()
+    np.testing.assert_allclose(
+        np.array([r["o1"] for r in rows], dtype=np.float32), want1,
+        rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.array([r["o2"] for r in rows], dtype=np.float32), want2,
+        rtol=1e-4, atol=1e-5)
+
+
+def test_multi_io_rejected_at_single_io_surfaces(rng):
+    """Multi-IO Keras models must fail EAGERLY with guidance at the
+    single-column surfaces (KerasTransformer etc.), not deep in a trace."""
+    from sparkdl_tpu.ml import KerasTransformer
+
+    a_in = keras.Input((4,), name="a")
+    b_in = keras.Input((4,), name="b")
+    out = layers.Add()([a_in, b_in])
+    m = keras.Model([a_in, b_in], out)
+    t = KerasTransformer(inputCol="x", outputCol="y", model=m)
+    with pytest.raises(ValueError, match="inputMapping"):
+        t.loadKerasModelAsFunction()
+
+
+def test_duplicate_output_names_rejected(rng):
+    shared = layers.Dense(3, name="shared")
+    a_in = keras.Input((4,), name="a")
+    b_in = keras.Input((4,), name="b")
+    m = keras.Model([a_in, b_in], [shared(a_in), shared(b_in)])
+    with pytest.raises(ValueError, match="not unique"):
+        keras_to_model_function(m)
