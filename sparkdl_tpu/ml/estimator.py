@@ -122,6 +122,12 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
     def getKerasFitParams(self) -> Dict[str, Any]:
         return dict(self.getOrDefault(self.kerasFitParams))
 
+    @staticmethod
+    def _compute_dtype(fit_params: Dict[str, Any]):
+        """mixed_precision fit param -> Trainer compute dtype (one policy
+        for both the streaming and collected fit paths)."""
+        return "bfloat16" if fit_params.get("mixed_precision") else None
+
     # -- data staging --------------------------------------------------------
 
     def _loaded_frame(self, dataset):
@@ -219,8 +225,7 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         trainer, state = Trainer.from_model_function(
             mf, loss=self.getKerasLoss(), optimizer=self.getKerasOptimizer(),
             learning_rate=lr, mesh=mesh,
-            compute_dtype="bfloat16" if fit_params.get("mixed_precision")
-            else None)
+            compute_dtype=self._compute_dtype(fit_params))
         state = trainer.fit(state, stream, epochs=epochs)
         if stream.batches_last_epoch == 0:
             raise ValueError("No decodable training images")
@@ -279,8 +284,7 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         trainer, state = Trainer.from_model_function(
             mf, loss=self.getKerasLoss(), optimizer=self.getKerasOptimizer(),
             learning_rate=lr, mesh=mesh,
-            compute_dtype="bfloat16" if fit_params.get("mixed_precision")
-            else None)
+            compute_dtype=self._compute_dtype(fit_params))
         state = trainer.fit(state, batches, epochs=epochs)
         return self._wrap_trained(mf, state)
 

@@ -1,0 +1,114 @@
+"""Fused inference fast path for the ResNet v1 family.
+
+Same design as ``models/inception_fast.py`` (the definitional Flax module
+stays in ``models/resnet.py``; this is a hand-written apply over the SAME
+variables tree, equality-tested):
+
+- **BN folding**: every conv here carries a bias and is followed by BN, so
+  at inference ``BN(conv(x)+b)`` folds to one conv with
+  ``k' = k * inv*scale`` and ``b' = (b - mean) * inv*scale + beta``.
+- **Shortcut fusion**: in each stage's downsampling block the shortcut
+  conv (4F out) and the main path's first conv (F out) share the input,
+  kernel size (1x1) and stride — one 5F-wide conv computes both, read the
+  block input from HBM once, split after.
+
+MEASURED NEUTRAL (r3): the plain module path already reaches ~48% MFU at
+b128/224 bf16 (12.2k img/s on a v5e-class chip) — ResNet's big uniform
+convs are exactly what XLA tiles well, its BN is fused into conv epilogues
+by XLA anyway, and only 4 blocks have a fusable shortcut pair. The fast
+path measured within noise of the module (-1%), so the registry does NOT
+select it; it stays as an equality-tested demonstration that the folding
+technique generalizes (InceptionV3's fast path, by contrast, wins ~13%
+because its many narrow branch convs underuse MXU lanes).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sparkdl_tpu.models.layers import RESNET_BN_EPS, max_pool, pad2d
+
+_DIMS = ("NHWC", "HWIO", "NHWC")
+
+
+def _folded(params: Any, stats: Any, conv: str, bn: str, compute_dtype
+            ) -> Tuple[jax.Array, jax.Array]:
+    """BN-folded (kernel, bias) for a conv+BN pair (f32 math, one cast)."""
+    k = jnp.asarray(params[conv]["kernel"], jnp.float32)
+    b = jnp.asarray(params[conv]["bias"], jnp.float32)
+    scale = jnp.asarray(params[bn]["scale"], jnp.float32)
+    beta = jnp.asarray(params[bn]["bias"], jnp.float32)
+    mean = jnp.asarray(stats[bn]["mean"], jnp.float32)
+    var = jnp.asarray(stats[bn]["var"], jnp.float32)
+    inv = jax.lax.rsqrt(var + RESNET_BN_EPS) * scale
+    return ((k * inv).astype(compute_dtype),
+            ((b - mean) * inv + beta).astype(compute_dtype))
+
+
+def _conv(x, kernel, bias, strides=(1, 1), padding="SAME", relu=False):
+    y = jax.lax.conv_general_dilated(
+        x, kernel, window_strides=strides, padding=padding,
+        dimension_numbers=_DIMS)
+    y = y + bias
+    return jax.nn.relu(y) if relu else y
+
+
+def resnet_fast_apply(variables: Any, x: jax.Array,
+                      stack_sizes: Sequence[int] = (3, 4, 6, 3),
+                      include_top: bool = False,
+                      pooling: Optional[str] = "avg",
+                      compute_dtype=jnp.bfloat16) -> jax.Array:
+    """Inference-only ResNet v1 forward over the standard variables tree.
+
+    Mirrors ``models/resnet.py`` (stem pad+7x7 VALID, stride-2 on the
+    first 1x1 of downsampling blocks, keras BN eps).
+    """
+    params = variables["params"]
+    stats = variables["batch_stats"]
+    x = x.astype(compute_dtype)
+
+    k, b = _folded(params, stats, "conv1_conv", "conv1_bn", compute_dtype)
+    x = _conv(pad2d(x, 3), k, b, strides=(2, 2), padding="VALID", relu=True)
+    x = pad2d(x, 1)
+    x = max_pool(x, 3, 2)
+
+    for stage, blocks in enumerate(stack_sizes):
+        stride = 1 if stage == 0 else 2
+        for i in range(1, blocks + 1):
+            name = f"conv{stage + 2}_block{i}"
+            p = params[name]
+            s = stats[name]
+            if i == 1:
+                # downsampling block: fuse shortcut conv_0 (4F) with main
+                # conv_1 (F) — same input / kernel / stride
+                k0, b0 = _folded(p, s, "conv_0", "bn_0", compute_dtype)
+                k1, b1 = _folded(p, s, "conv_1", "bn_1", compute_dtype)
+                wide = _conv(x, jnp.concatenate([k0, k1], axis=3),
+                             jnp.concatenate([b0, b1], axis=0),
+                             strides=(stride, stride))
+                n0 = k0.shape[3]
+                shortcut = wide[..., :n0]
+                y = jax.nn.relu(wide[..., n0:])
+            else:
+                shortcut = x
+                k1, b1 = _folded(p, s, "conv_1", "bn_1", compute_dtype)
+                y = _conv(x, k1, b1, relu=True)
+            k2, b2 = _folded(p, s, "conv_2", "bn_2", compute_dtype)
+            y = _conv(y, k2, b2, relu=True)
+            k3, b3 = _folded(p, s, "conv_3", "bn_3", compute_dtype)
+            y = _conv(y, k3, b3)
+            x = jax.nn.relu(shortcut + y)
+
+    if include_top:
+        x = jnp.mean(x, axis=(1, 2))
+        p = params["predictions"]
+        logits = x @ p["kernel"].astype(x.dtype) + p["bias"].astype(x.dtype)
+        return jax.nn.softmax(logits)
+    if pooling == "avg":
+        return jnp.mean(x, axis=(1, 2))
+    if pooling == "max":
+        return jnp.max(x, axis=(1, 2))
+    return x

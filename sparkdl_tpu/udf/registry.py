@@ -185,6 +185,14 @@ def registerKerasImageUDF(udfName: str, kerasModelOrFile: Any,
     else:
         keras_model = kerasModelOrFile
     mf = keras_to_model_function(keras_model, name=udfName)
+    # single-IO surface: an image UDF binds one image column to one output
+    # column — reject multi-IO models HERE, not deep inside a transform
+    if isinstance(mf.input_spec, dict) or len(keras_model.outputs) > 1:
+        raise ValueError(
+            f"registerKerasImageUDF binds one image column to one output; "
+            f"model {udfName!r} has {len(keras_model.inputs)} inputs / "
+            f"{len(keras_model.outputs)} outputs — serve multi-IO models "
+            "via TPUTransformer inputMapping/outputMapping")
     return registerImageUDF(udfName, mf, batchSize=batchSize,
                             preprocessor=preprocessor, mesh=mesh,
                             registry=registry)

@@ -1,0 +1,47 @@
+"""ResNet fast path == Flax module (f32, CPU, 32x32 smallest-valid input).
+
+The path is equality-tested but NOT registry-selected: it measured neutral
+on TPU (see models/resnet_fast.py docstring) because XLA already handles
+ResNet's uniform convs well. Kept as the generalization proof of the
+BN-folding/branch-fusion technique.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparkdl_tpu.models.resnet import ResNet50
+from sparkdl_tpu.models.resnet_fast import resnet_fast_apply
+
+
+@pytest.fixture(scope="module")
+def setup():
+    rng = np.random.default_rng(0)
+    x = rng.uniform(-1.0, 1.0, size=(2, 32, 32, 3)).astype(np.float32)
+    mod = ResNet50(include_top=True, classes=1000)
+    vs = jax.jit(mod.init)(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 32, 32, 3), jnp.float32))
+    return x, vs
+
+
+def test_predict_matches_module(setup):
+    x, vs = setup
+    mod = ResNet50(include_top=True, classes=1000)
+    want = np.asarray(mod.apply(vs, x, train=False))
+    got = np.asarray(resnet_fast_apply(vs, x, include_top=True,
+                                       compute_dtype=jnp.float32))
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-6)
+
+
+def test_featurize_matches_module(setup):
+    x, vs = setup
+    feat_vars = {"params": {k: v for k, v in vs["params"].items()
+                            if k != "predictions"},
+                 "batch_stats": vs["batch_stats"]}
+    mod = ResNet50(include_top=False, pooling="avg")
+    want = np.asarray(mod.apply(feat_vars, x, train=False))
+    got = np.asarray(resnet_fast_apply(feat_vars, x, include_top=False,
+                                       compute_dtype=jnp.float32))
+    assert got.shape == want.shape == (2, 2048)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)

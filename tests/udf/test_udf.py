@@ -97,3 +97,16 @@ def test_select_expr_plain_and_alias():
     assert list(out[0].keys()) == ["z", "x"]
     with pytest.raises(ValueError, match="tokenize"):
         df.selectExpr("sum(x) + 1")
+
+
+def test_register_keras_image_udf_rejects_multi_io():
+    keras = pytest.importorskip("keras")
+    from keras import layers
+
+    from sparkdl_tpu.udf import registerKerasImageUDF
+
+    a = keras.Input((8, 8, 3), name="a")
+    b = keras.Input((8, 8, 3), name="b")
+    m = keras.Model([a, b], layers.Add()([a, b]))
+    with pytest.raises(ValueError, match="inputMapping"):
+        registerKerasImageUDF("multi_io_udf", m)
