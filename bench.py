@@ -90,7 +90,7 @@ def make_slope_measurer(apply_fn, variables, x_np, ks=(2, 18), repeats=4):
     return measure
 
 
-def bench_headline():
+def bench_device_featurize(name, size, flops_per_img):
     """Best of 3 measurements: the real chip's clock state drifts between
     consecutive runs (measured 10.1k -> 7.8k across back-to-back processes
     with identical code), and the metric compares code versions, so the
@@ -100,15 +100,15 @@ def bench_headline():
 
     from sparkdl_tpu.models import registry
 
-    mf = registry.build_featurizer("InceptionV3", weights="random",
+    mf = registry.build_featurizer(name, weights="random",
                                    dtype=jnp.bfloat16)
     rng = np.random.default_rng(0)
-    x = rng.integers(0, 255, size=(HEADLINE_BATCH, 299, 299, 3)
+    x = rng.integers(0, 255, size=(HEADLINE_BATCH,) + size + (3,)
                      ).astype(np.float32)
     measure = make_slope_measurer(mf.apply_fn, mf.variables, x)
     runs = [measure() for _ in range(3)]
     ips, spread = max(runs)
-    mfu = ips * FLOPS_PER_IMG_INCEPTION / 1e12 / PEAK_TFLOPS_BF16
+    mfu = ips * flops_per_img / 1e12 / PEAK_TFLOPS_BF16
     return ips, spread, mfu, [round(r[0], 1) for r in runs]
 
 
@@ -282,22 +282,13 @@ def main():
 
             # device throughput for the other flagship CNN: ResNet50's big
             # uniform convs hit ~48% MFU (vs InceptionV3's branchy ~29%)
-            import jax.numpy as jnp
-
-            from sparkdl_tpu.models import registry
-
-            rmf = registry.build_featurizer("ResNet50", weights="random",
-                                            dtype=jnp.bfloat16)
-            rng = np.random.default_rng(0)
-            rx = rng.integers(0, 255, size=(HEADLINE_BATCH, 224, 224, 3)
-                              ).astype(np.float32)
-            rips, _ = make_slope_measurer(rmf.apply_fn, rmf.variables, rx)()
+            rips, _, rmfu, rruns = bench_device_featurize(
+                "ResNet50", (224, 224), FLOPS_PER_IMG_RESNET50)
             emit("images/sec/chip (ResNet50 featurize)", rips,
-                 "images/sec/chip",
-                 mfu=round(rips * FLOPS_PER_IMG_RESNET50 / 1e12
-                           / PEAK_TFLOPS_BF16, 4))
+                 "images/sec/chip", mfu=round(rmfu, 4), runs=rruns)
 
-        ips, spread, mfu, runs = bench_headline()
+        ips, spread, mfu, runs = bench_device_featurize(
+            "InceptionV3", (299, 299), FLOPS_PER_IMG_INCEPTION)
         emit("images/sec/chip (InceptionV3 featurize)", ips,
              "images/sec/chip", spread=round(spread, 4), mfu=round(mfu, 4),
              runs=runs)

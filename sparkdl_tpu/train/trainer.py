@@ -68,6 +68,10 @@ class Trainer:
     # so no loss scaling is needed on TPU) while master params, optimizer
     # state and the update stay float32. None = full precision.
     compute_dtype: Any = None
+    # Gradient checkpointing (jax.checkpoint): recompute activations in the
+    # backward pass instead of storing them — HBM for larger batches at the
+    # cost of ~1 extra forward of FLOPs.
+    remat: bool = False
 
     # -- constructors --------------------------------------------------------
 
@@ -167,6 +171,9 @@ class Trainer:
         """
         loss_fn = self.loss
         apply_fn = self.apply_fn
+        if self.remat:
+            apply_fn = jax.checkpoint(apply_fn,
+                                      static_argnums=(2,))  # `train` flag
         optimizer = self.optimizer
         has_state = self.has_model_state
         want_acc = self.compute_accuracy
