@@ -67,11 +67,15 @@ class Trainer:
     # forward/backward run in this dtype (bf16 keeps f32's exponent range,
     # so no loss scaling is needed on TPU) while master params, optimizer
     # state and the update stay float32. None = full precision.
+    #
+    # NOTE on gradient checkpointing: a Trainer-level whole-model
+    # jax.checkpoint was tried and REMOVED — one monolithic checkpoint
+    # does not reduce peak HBM (the backward's recompute materializes the
+    # same residual set before transposing; it only adds ~1 forward of
+    # FLOPs). Memory-bound models should use flax ``nn.remat`` on block
+    # boundaries inside the module definition, which the Trainer runs
+    # unchanged.
     compute_dtype: Any = None
-    # Gradient checkpointing (jax.checkpoint): recompute activations in the
-    # backward pass instead of storing them — HBM for larger batches at the
-    # cost of ~1 extra forward of FLOPs.
-    remat: bool = False
 
     # -- constructors --------------------------------------------------------
 
@@ -171,9 +175,6 @@ class Trainer:
         """
         loss_fn = self.loss
         apply_fn = self.apply_fn
-        if self.remat:
-            apply_fn = jax.checkpoint(apply_fn,
-                                      static_argnums=(2,))  # `train` flag
         optimizer = self.optimizer
         has_state = self.has_model_state
         want_acc = self.compute_accuracy

@@ -277,33 +277,3 @@ def test_mixed_precision_batch_stats_stay_f32(rng):
     # the moving averages must actually MOVE: bf16 stats would stall on
     # small momentum increments (the update stays f32 by design)
     assert any(not np.allclose(a, b) for a, b in zip(init_stats, new_stats))
-
-
-def test_remat_matches_plain_training(rng):
-    """jax.checkpoint recomputes activations; gradients are mathematically
-    identical, so trained params must match the plain path bit-for-bit."""
-    import flax.linen as nn
-
-    class Net(nn.Module):
-        @nn.compact
-        def __call__(self, x, train=False):
-            x = nn.Dense(16)(x)
-            x = nn.relu(x)
-            return nn.softmax(nn.Dense(3)(x))
-
-    module = Net()
-    x = rng.normal(size=(16, 8)).astype(np.float32)
-    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, size=16)]
-    variables = module.init(jax.random.PRNGKey(0), x[:1])
-
-    def train(remat):
-        trainer, state = Trainer.from_flax(
-            module, variables, loss="categorical_crossentropy",
-            optimizer="sgd", learning_rate=0.1, remat=remat)
-        return jax.device_get(
-            trainer.fit(state, [(x, y)] * 5, epochs=1).params)
-
-    plain = train(False)
-    rematted = train(True)
-    for a, b in zip(jax.tree.leaves(plain), jax.tree.leaves(rematted)):
-        np.testing.assert_array_equal(a, b)
