@@ -230,3 +230,21 @@ def test_select_expr_arity_and_parse_errors(rng):
             df.selectExpr("nope")
     finally:
         udf_registry.unregister("one_arg_test")
+
+
+def test_stream_partitions_order(rng):
+    df = DataFrame.fromColumns({"v": np.arange(12, dtype=np.int64)},
+                               numPartitions=4)
+    df = df.withColumn("w", lambda v: v + 1, inputCols=["v"])
+    natural = [p.column(0).to_pylist() for p in df.streamPartitions()]
+    order = [2, 0, 3, 1]
+    permuted = [p.column(0).to_pylist()
+                for p in df.streamPartitions(order=order)]
+    assert permuted == [natural[i] for i in order]
+    # cached frames honor order too
+    df.cache().collect() if hasattr(df, "cache") else None
+    df2 = df
+    df2.toArrow()  # materializes
+    permuted2 = [p.column(0).to_pylist()
+                 for p in df2.streamPartitions(order=order)]
+    assert permuted2 == permuted
