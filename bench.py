@@ -111,9 +111,13 @@ def bench_device_featurize(name, size, flops_per_img):
                      ).astype(np.float32)
     measure = make_slope_measurer(mf.apply_fn, mf.variables, x)
     runs = [measure() for _ in range(3)]
-    ips, spread = max(runs)
+    ips, spread = max(runs, key=lambda r: r[0])
+    # cross-run spread (clock drift between measurements), alongside the
+    # winning run's own long-loop spread
+    values = [r[0] for r in runs]
+    cross = (max(values) - min(values)) / min(values)
     mfu = ips * flops_per_img / 1e12 / PEAK_TFLOPS_BF16
-    return ips, spread, mfu, [round(r[0], 1) for r in runs]
+    return ips, max(spread, cross), mfu, [round(v, 1) for v in values]
 
 
 def _write_jpegs(directory, n, rng):

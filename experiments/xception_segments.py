@@ -1,0 +1,129 @@
+"""Xception per-segment attribution: stem / entry / middle / exit (TPU).
+
+Uses the real Flax module with init'd params, but applies truncated
+forward passes (stop after segment K) via flax module subclassing; segment
+time = difference of successive slope measurements at b128 bf16.
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+from bench import make_slope_measurer  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import flax.linen as nn  # noqa: E402
+
+from sparkdl_tpu.models.layers import (  # noqa: E402
+    KERAS_BN_EPS, SeparableConvBN, global_avg_pool,
+)
+
+B = 128
+
+
+class XceptionTrunc(nn.Module):
+    """Xception featurize forward, stopping after ``stop`` segment:
+    1=stem(block1), 2=entry(blocks2-4), 3=middle(5-12), 4=exit(13-14)+gap.
+
+    Mirrors models/xception.py exactly so segment times are the real ones.
+    """
+
+    stop: int = 4
+    dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        bn = lambda name: nn.BatchNorm(  # noqa: E731
+            use_running_average=not train, epsilon=KERAS_BN_EPS,
+            momentum=0.99, dtype=self.dtype, name=name)
+
+        def sep(h, features, name):
+            return SeparableConvBN(features, dtype=self.dtype, name=name)(
+                h, train)
+
+        x = nn.Conv(32, (3, 3), strides=(2, 2), padding="VALID",
+                    use_bias=False, dtype=self.dtype, name="block1_conv1")(x)
+        x = nn.relu(bn("block1_conv1_bn")(x))
+        x = nn.Conv(64, (3, 3), padding="VALID", use_bias=False,
+                    dtype=self.dtype, name="block1_conv2")(x)
+        x = nn.relu(bn("block1_conv2_bn")(x))
+        if self.stop == 1:
+            return global_avg_pool(x)
+
+        for i, features in zip((2, 3, 4), (128, 256, 728)):
+            residual = nn.Conv(features, (1, 1), strides=(2, 2),
+                               padding="SAME", use_bias=False,
+                               dtype=self.dtype, name=f"block{i}_res_conv")(x)
+            residual = bn(f"block{i}_res_bn")(residual)
+            if i > 2:
+                x = nn.relu(x)
+            x = sep(x, features, f"block{i}_sepconv1")
+            x = nn.relu(x)
+            x = sep(x, features, f"block{i}_sepconv2")
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+            x = x + residual
+        if self.stop == 2:
+            return global_avg_pool(x)
+
+        for i in range(5, 13):
+            residual = x
+            x = nn.relu(x)
+            x = sep(x, 728, f"block{i}_sepconv1")
+            x = nn.relu(x)
+            x = sep(x, 728, f"block{i}_sepconv2")
+            x = nn.relu(x)
+            x = sep(x, 728, f"block{i}_sepconv3")
+            x = x + residual
+        if self.stop == 3:
+            return global_avg_pool(x)
+
+        residual = nn.Conv(1024, (1, 1), strides=(2, 2), padding="SAME",
+                           use_bias=False, dtype=self.dtype,
+                           name="block13_res_conv")(x)
+        residual = bn("block13_res_bn")(residual)
+        x = nn.relu(x)
+        x = sep(x, 728, "block13_sepconv1")
+        x = nn.relu(x)
+        x = sep(x, 1024, "block13_sepconv2")
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = x + residual
+        x = sep(x, 1536, "block14_sepconv1")
+        x = nn.relu(x)
+        x = sep(x, 2048, "block14_sepconv2")
+        x = nn.relu(x)
+        return global_avg_pool(x)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(B, 299, 299, 3)).astype(np.float32) * 50
+    full = XceptionTrunc(stop=4)
+    variables = jax.jit(full.init)(jax.random.PRNGKey(0),
+                                   jnp.zeros((1, 299, 299, 3), jnp.float32))
+    times = {}
+    for stop, label in ((1, "stem"), (2, "entry"), (3, "middle"),
+                        (4, "full")):
+        m = XceptionTrunc(stop=stop)
+
+        def apply_fn(v, xx):
+            return m.apply(v, xx.astype(jnp.bfloat16), train=False)
+
+        meas = make_slope_measurer(apply_fn, variables, x)
+        ips = max(meas()[0] for _ in range(3))
+        times[label] = B / ips * 1e3
+        print(f"stop={label:7s} {ips:9.1f} img/s  cum={times[label]:.2f} ms",
+              flush=True)
+    prev = 0.0
+    for label in ("stem", "entry", "middle", "full"):
+        seg = times[label] - prev
+        print(f"segment {label:7s} {seg:6.2f} ms/batch128")
+        prev = times[label]
+
+
+if __name__ == "__main__":
+    t0 = time.time()
+    main()
+    print(f"total {time.time() - t0:.0f}s")

@@ -142,6 +142,15 @@ class use_mesh:
 
     Context-local: ``use_mesh(None)`` masks the process default inside the
     scope; other threads/contexts are unaffected.
+
+    ContextVar scoping cuts both ways (ADVICE r3): a scope entered on the
+    driver thread is INVISIBLE to threads spawned inside it, including the
+    engine's partition-pool workers. Therefore ``resolveMesh()`` (and any
+    ``get_default_mesh()`` call meant to observe a ``use_mesh`` scope) must
+    run on the driver thread BEFORE partition closures are built — which
+    every in-tree call site does, resolving the mesh eagerly in
+    ``_transform`` and capturing the resolved Mesh object into the closure.
+    Do not call ``resolveMesh()`` lazily inside a partition op.
     """
 
     def __init__(self, mesh: Optional[Mesh]) -> None:

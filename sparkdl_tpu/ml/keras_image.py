@@ -86,7 +86,9 @@ class KerasImageFileTransformer(Transformer, HasInputCol, HasOutputCol,
         return super().setModelFile(value)
 
     # persistence: ingested Keras DAG → StableHLO (ModelFunctionPersistence)
-    _persist_skip = ("mesh", "modelFile")
+    # model (live Keras object) and imageLoader are artifact-/guard-handled
+    _persist_skip = ("mesh", "modelFile", "model", "imageLoader",
+                     "modelFunction")
     _persist_check_loader = True
     _persist_name = "keras_image_file"
 

@@ -65,7 +65,7 @@ class KerasTransformer(Transformer, HasInputCol, HasOutputCol,
         return super().setModelFile(value)
 
     # persistence: ingested Keras DAG → StableHLO (ModelFunctionPersistence)
-    _persist_skip = ("mesh", "modelFile")
+    _persist_skip = ("mesh", "modelFile", "model", "modelFunction")
     _persist_name = "keras_tensor"
 
     def _persist_model_function(self):

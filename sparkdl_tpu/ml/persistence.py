@@ -71,7 +71,13 @@ def read_metadata(path: str) -> Dict[str, Any]:
 
 
 def jsonable_params(instance, skip=("mesh",)) -> Dict[str, Any]:
-    """Explicitly-set + defaulted params that JSON-serialize, by name."""
+    """Explicitly-set + defaulted params that JSON-serialize, by name.
+
+    A non-JSON value on an *explicitly set* param raises: dropping it
+    silently would reload the stage with different behavior. Unset defaults
+    that fail (a future complex-valued default) are skipped — the class
+    restores them on construction.
+    """
     out: Dict[str, Any] = {}
     for param in instance.params:
         if param.name in skip:
@@ -82,6 +88,12 @@ def jsonable_params(instance, skip=("mesh",)) -> Dict[str, Any]:
         try:
             json.dumps(value)
         except TypeError:
+            if instance.isSet(param):
+                raise ValueError(
+                    f"Param {param.name!r}={value!r} is not JSON-"
+                    "serializable and would be silently lost on save; "
+                    "clear it or add it to the stage's _persist_skip "
+                    "(with a matching artifact) to persist this stage")
             continue
         out[param.name] = value
     return out
@@ -145,7 +157,8 @@ class ModelFunctionPersistence:
     ``_persist_model_function()`` / ``_restore_model_function(mf)``.
     """
 
-    _persist_skip = ("mesh",)
+    # mesh is runtime-only; modelFunction is the artifact itself
+    _persist_skip = ("mesh", "modelFunction")
     _persist_check_loader = False
     _persist_name = "model"
 

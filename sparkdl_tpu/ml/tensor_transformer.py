@@ -15,6 +15,7 @@ import numpy as np
 import pyarrow as pa
 
 from sparkdl_tpu.engine.dataframe import (
+    _schema_with,
     _set_column,
     column_to_numpy,
     fixed_size_list_array,
@@ -74,7 +75,7 @@ class TPUTransformer(Transformer, HasInputCol, HasOutputCol,
         typeConverter=SparkDLTypeConverters.asOutputToColumnMap)
 
     _persist_name = "tpu_transformer"
-    _persist_skip = ("mesh",)
+    _persist_skip = ("mesh", "modelFunction")
 
     @keyword_only
     def __init__(self, *, inputCol: Optional[str] = None,
@@ -200,7 +201,10 @@ class TPUTransformer(Transformer, HasInputCol, HasOutputCol,
                     fixed_size_list_array(flat).cast(pa.list_(pa.float32())))
             return result
 
+        # declared schema must mirror _set_column (replace-in-place when an
+        # output column name already exists, append if new) or a colliding
+        # outputMapping would declare a duplicate field the batches lack
         schema = dataset.schema
         for _name, col in out_cols:
-            schema = schema.append(pa.field(col, pa.list_(pa.float32())))
+            schema = _schema_with(schema, col, pa.list_(pa.float32()))
         return dataset.mapPartitions(apply_partition, schema=schema)

@@ -110,7 +110,13 @@ class HasMesh(Params):
         return self.getOrDefault(self.mesh)
 
     def resolveMesh(self):
-        """Explicit param if set, else the framework default mesh."""
+        """Explicit param if set, else the framework default mesh.
+
+        Must be called on the driver thread before partition closures are
+        built: ``use_mesh`` scoping is ContextVar-local and invisible to
+        engine pool workers (see ``core.mesh.use_mesh``). Resolve eagerly
+        in ``_transform`` and capture the Mesh into the closure.
+        """
         from sparkdl_tpu.core.mesh import get_default_mesh
 
         mesh = self.getOrDefault(self.mesh)

@@ -241,6 +241,24 @@ def test_tensor_transformer_multi_io_mesh_matches_single(rng):
     np.testing.assert_allclose(run(mesh8), run(None), rtol=1e-6, atol=1e-6)
 
 
+def test_tensor_transformer_multi_io_overwrites_existing_column(rng):
+    """outputMapping onto an existing column replaces it in place — the
+    declared schema must not carry a duplicate field (ADVICE r3)."""
+    mf = _two_io_model()
+    a = rng.normal(size=(9, 4)).astype(np.float32)
+    b = rng.normal(size=(9, 4)).astype(np.float32)
+    df = DataFrame.fromColumns({"colA": a, "colB": b}, numPartitions=2)
+    t = TPUTransformer(modelFunction=mf,
+                       inputMapping={"colA": "a", "colB": "b"},
+                       outputMapping={"sum": "colA", "prod_mean": "pm"},
+                       batchSize=4)
+    out = t.transform(df)
+    assert out.columns == ["colA", "colB", "pm"]
+    got = np.array([r["colA"] for r in out.select("colA").collect()],
+                   dtype=np.float32)
+    np.testing.assert_allclose(got, a + b, rtol=1e-6, atol=1e-6)
+
+
 def test_tensor_transformer_multi_io_validation(rng):
     mf = _two_io_model()
     df = DataFrame.fromColumns({"colA": rng.normal(size=(3, 4)).astype(np.float32)})
