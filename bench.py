@@ -32,8 +32,10 @@ Run ``python bench.py --headline`` for just the headline metric;
 trace of everything.
 """
 
+import glob
 import json
 import os
+import re
 import sys
 import tempfile
 import time
@@ -46,10 +48,66 @@ FLOPS_PER_IMG_INCEPTION = 5.7e9   # fwd, 2*MACs, 299x299
 FLOPS_PER_IMG_RESNET50 = 7.75e9   # fwd, 2*MACs, 224x224
 PEAK_TFLOPS_BF16 = 197            # v5e
 
+# Metrics where a SMALLER value is the improvement (step times).
+_LOWER_IS_BETTER = ("ms/step",)
+
+
+def _load_prior_round():
+    """metric -> (value, unit, round_tag) from the newest BENCH_r*.json.
+
+    The driver writes BENCH_r{N}.json after each round with the bench
+    stdout under "tail" (one JSON object per line, possibly truncated).
+    The reference itself publishes no numbers (BASELINE.json
+    ``published: {}``), so "baseline" for regression purposes is the
+    previous round's driver-captured envelope (VERDICT r3 #2).
+    """
+    best = {}
+    paths = sorted(glob.glob(os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "BENCH_r*.json")))
+    if not paths:
+        return best
+    path = paths[-1]
+    tag = re.search(r"BENCH_(r\d+)", os.path.basename(path)).group(1)
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return best
+    for line in str(doc.get("tail", "")).splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec and "value" in rec:
+            value = float(rec["value"])
+            if value <= 0:  # invalid-measurement marker (e.g. -1)
+                continue
+            best[rec["metric"]] = (value, rec.get("unit", ""), tag)
+    return best
+
+
+_PRIOR = None
+
 
 def emit(metric, value, unit, **extra):
+    """One JSON line. vs_baseline = this value vs the previous round's
+    driver-captured value for the same metric, normalized so >1.0 is an
+    improvement (inverted for ms/step where lower is better)."""
+    global _PRIOR
+    if _PRIOR is None:
+        _PRIOR = _load_prior_round()
     rec = {"metric": metric, "value": round(float(value), 2), "unit": unit,
            "vs_baseline": None}
+    prior = _PRIOR.get(metric)
+    if prior and prior[0] > 0 and value > 0:
+        ratio = (prior[0] / float(value)) if unit in _LOWER_IS_BETTER \
+            else (float(value) / prior[0])
+        rec["vs_baseline"] = round(ratio, 4)
+        rec["baseline_value"] = prior[0]
+        rec["baseline_round"] = prior[2]
     rec.update(extra)
     print(json.dumps(rec), flush=True)
     return rec
@@ -152,8 +210,8 @@ def bench_e2e_featurize(n_images=384):
             out = t.transform(df).select("features").collect()
             assert len(out) == n_images
         run()  # warmup: compile + host caches
-        best = min(_timed(run) for _ in range(2))
-    return n_images / best
+        best, spread = _best_of(run)
+    return n_images / best, spread
 
 
 def bench_batch_inference(name, n_images=256, size=(224, 224)):
@@ -179,8 +237,8 @@ def bench_batch_inference(name, n_images=256, size=(224, 224)):
         out = t.transform(df).select("pred").collect()
         assert len(out) == n_images
     run()
-    best = min(_timed(run) for _ in range(2))
-    return n_images / best
+    best, spread = _best_of(run)
+    return n_images / best, spread
 
 
 def bench_udf(n_rows=256):
@@ -207,8 +265,59 @@ def bench_udf(n_rows=256):
         out = df.selectExpr("bench_inception_udf(image) as pred").collect()
         assert len(out) == n_rows
     run()
-    best = min(_timed(run) for _ in range(2))
-    return n_rows / best
+    best, spread = _best_of(run)
+    return n_rows / best, spread
+
+
+def bench_streaming_fit(n_images=768):
+    """Config 4 END-TO-END (VERDICT r3 #3): JPEG files -> URI frame ->
+    streaming decode -> KerasImageFileEstimator.fit of a real MobileNetV2
+    (keras-ingested), mixed precision.
+
+    Every public ``fit`` builds+compiles its own train step (~15 s over
+    the tunnel), so the STEADY-STATE pipeline rate is measured as the
+    epoch marginal: ``2n / (t(3 epochs) - t(1 epoch))`` — compile and
+    ingestion cancel, leaving pure decode->stage->train throughput. The
+    phase breakdown (decode / stage / train_step wall seconds, 3-epoch
+    run) shows whether host decode starves the MXU (SURVEY.md §7 #2)."""
+    from sparkdl_tpu.core import profiling
+    from sparkdl_tpu.engine.dataframe import DataFrame
+    from sparkdl_tpu.ml import KerasImageFileEstimator
+
+    import keras
+
+    rng = np.random.default_rng(0)
+    with tempfile.TemporaryDirectory() as d:
+        paths = _write_jpegs(d, n_images, rng)
+        rows = [{"uri": p, "label": i % 10} for i, p in enumerate(paths)]
+        df = DataFrame.fromRows(rows, numPartitions=8)
+
+        def fit(epochs):
+            est = KerasImageFileEstimator(
+                inputCol="uri", outputCol="preds", labelCol="label",
+                model=keras.applications.MobileNetV2(weights=None,
+                                                     classes=10),
+                kerasOptimizer="sgd",
+                kerasLoss="sparse_categorical_crossentropy",
+                kerasFitParams={"epochs": epochs, "batch_size": 64,
+                                "learning_rate": 0.01, "shuffle": True,
+                                "streaming": True, "mixed_precision": True})
+            est.fit(df)
+
+        fit(1)  # warmup: host caches, keras import paths
+        t1 = min(_timed(lambda: fit(1)) for _ in range(2))
+        profiling.reset_phase_stats()
+        t3 = min(_timed(lambda: fit(3)) for _ in range(2))
+        phases = {name: round(s["total_s"], 3)
+                  for name, s in profiling.phase_stats().items()}
+    marginal = t3 - t1
+    if marginal < 0.5:
+        # each fit carries its own ~15 s tunnel compile; if noise swamps
+        # the 2-epoch marginal, emit an explicit invalid marker instead of
+        # a silently absurd rate (a poisoned value would become the next
+        # round's vs_baseline)
+        return -1.0, phases
+    return 2 * n_images / marginal, phases
 
 
 def bench_train_step(model_name, batch_size, mesh=None, compute_dtype=None):
@@ -246,15 +355,23 @@ def bench_train_step(model_name, batch_size, mesh=None, compute_dtype=None):
         return time.perf_counter() - t0
 
     run_k(2)
-    t_small = min(run_k(2) for _ in range(3))
-    t_large = min(run_k(10) for _ in range(3))
-    return (t_large - t_small) / 8
+    smalls = [run_k(2) for _ in range(3)]
+    larges = [run_k(10) for _ in range(3)]
+    spread = (max(larges) - min(larges)) / min(larges)
+    return (min(larges) - min(smalls)) / 8, spread
 
 
 def _timed(fn):
     t0 = time.perf_counter()
     fn()
     return time.perf_counter() - t0
+
+
+def _best_of(fn, n=3):
+    """(best_seconds, relative_spread) over n timed repeats (VERDICT r3 #2:
+    every wall-clock metric carries a spread, not just the device ones)."""
+    ts = [_timed(fn) for _ in range(n)]
+    return min(ts), (max(ts) - min(ts)) / min(ts)
 
 
 def main():
@@ -271,30 +388,37 @@ def main():
                         "images/sec/chip", spread=round(spread, 4),
                         mfu=round(mfu, 4), runs=runs)
         if not headline_only:
-            e2e = bench_e2e_featurize()
+            e2e, sp = bench_e2e_featurize()
             emit("e2e images/sec (files->readImages->InceptionV3 featurize)",
-                 e2e, "images/sec")
+                 e2e, "images/sec", spread=round(sp, 4))
             for name, size in (("ResNet50", (224, 224)),
                                ("Xception", (299, 299))):
-                ips = bench_batch_inference(name, size=size)
+                ips, sp = bench_batch_inference(name, size=size)
                 emit(f"batch inference images/sec ({name} predict)",
-                     ips, "images/sec")
-            rps = bench_udf()
+                     ips, "images/sec", spread=round(sp, 4))
+            rps, sp = bench_udf()
             emit("SQL UDF rows/sec (InceptionV3 via selectExpr)",
-                 rps, "rows/sec")
-            st = bench_train_step("MobileNetV2", 64)
-            st16 = bench_train_step("MobileNetV2", 64,
-                                    compute_dtype="bfloat16")
+                 rps, "rows/sec", spread=round(sp, 4))
+            sips, phases = bench_streaming_fit()
+            emit("e2e streaming fit images/sec (files->decode->MobileNetV2 "
+                 "train)", sips, "images/sec", phases=phases)
+            st, sp = bench_train_step("MobileNetV2", 64)
+            st16, sp16 = bench_train_step("MobileNetV2", 64,
+                                          compute_dtype="bfloat16")
             emit("fine-tune step time (MobileNetV2 b64)", st * 1e3, "ms/step",
-                 images_per_sec=round(64 / st, 2),
+                 images_per_sec=round(64 / st, 2), spread=round(sp, 4),
                  mixed_precision_ms=round(st16 * 1e3, 2),
-                 mixed_precision_images_per_sec=round(64 / st16, 2))
-            st = bench_train_step("ResNet50", 64)
-            st16 = bench_train_step("ResNet50", 64, compute_dtype="bfloat16")
+                 mixed_precision_images_per_sec=round(64 / st16, 2),
+                 mixed_precision_spread=round(sp16, 4))
+            st, sp = bench_train_step("ResNet50", 64)
+            st16, sp16 = bench_train_step("ResNet50", 64,
+                                          compute_dtype="bfloat16")
             emit("DP train step time (ResNet50 b64, 1 chip)", st * 1e3,
                  "ms/step", images_per_sec=round(64 / st, 2),
+                 spread=round(sp, 4),
                  mixed_precision_ms=round(st16 * 1e3, 2),
-                 mixed_precision_images_per_sec=round(64 / st16, 2))
+                 mixed_precision_images_per_sec=round(64 / st16, 2),
+                 mixed_precision_spread=round(sp16, 4))
 
             # device throughput for the other flagship CNN: ResNet50's big
             # uniform convs hit ~48% MFU (vs InceptionV3's branchy ~29%)

@@ -1,0 +1,97 @@
+"""Capture + parse a device trace of a zoo model featurize → top-N fusions.
+
+Produces the per-fusion cost table VERDICT r3 #1 asks for: which XLA
+fusions the 32 ms Xception batch actually spends time in, so the ceiling
+argument (depthwise = VPU-bound, pointwise = near-MXU-peak) is checkable
+against the compiler's own schedule rather than asserted.
+
+Run: python experiments/xception_profile.py [trace_dir] [model] [size]
+"""
+
+import glob
+import gzip
+import json
+import os
+import sys
+import tempfile
+import time
+from collections import defaultdict
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def capture(trace_dir: str, batches: int = 8, model: str = "Xception",
+            size: int = 299) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from sparkdl_tpu.models import registry
+
+    mf = registry.build_featurizer(model, weights="random",
+                                   dtype=jnp.bfloat16)
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 255, size=(128, size, size, 3)).astype(np.float32)
+    xd = jax.device_put(x)
+    fn = jax.jit(lambda v, xx: mf.apply_fn(v, xx))
+    jax.device_get(fn(mf.variables, xd))  # compile outside the trace
+    with jax.profiler.trace(trace_dir):
+        for _ in range(batches):
+            out = fn(mf.variables, xd)
+        jax.device_get(out)
+
+
+def parse(trace_dir: str, top: int = 20):
+    """Roofline table per HLO fusion: duration, achieved TFLOP/s (the
+    trace records model_flops) and achieved GB/s (bytes_accessed), grouped
+    by the model layer (tf_op) the fusion implements."""
+    paths = glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
+                      recursive=True)
+    assert paths, f"no trace under {trace_dir}"
+    with gzip.open(sorted(paths)[-1], "rt") as f:
+        doc = json.load(f)
+    events = doc.get("traceEvents", [])
+    agg = defaultdict(lambda: [0.0, 0, 0.0, 0.0, "", ""])
+    wall = 0.0
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        args = e.get("args") or {}
+        if "hlo_category" not in args:
+            continue  # parent jit span / host events: no double counting
+        name = e.get("name", "?")
+        dur = float(e.get("dur", 0.0))
+        row = agg[name]
+        row[0] += dur
+        row[1] += 1
+        row[2] = float(args.get("model_flops", 0) or 0)
+        row[3] = float(args.get("raw_bytes_accessed",
+                                args.get("bytes_accessed", 0)) or 0)
+        op = args.get("tf_op", "")
+        row[4] = "/".join(op.split("/")[1:3]) if "/" in op else op
+        row[5] = args.get("hlo_category", "")
+        wall += dur
+    rows = sorted(agg.items(), key=lambda kv: -kv[1][0])[:top]
+    print(f"device fusion time total {wall / 1e3:.1f} ms "
+          f"({len(agg)} fusions)")
+    print(f"{'layer (tf_op)':34s} {'category':20s} {'ms/b':>6s} {'%':>5s} "
+          f"{'TF/s':>6s} {'GB/s':>6s}")
+    for name, (tot, n, flops, bts, op, cat) in rows:
+        per = tot / n  # us per batch execution
+        tfs = flops / (per * 1e-6) / 1e12 if per else 0.0
+        gbs = bts / (per * 1e-6) / 1e9 if per else 0.0
+        print(f"{(op or name)[:34]:34s} {cat[:20]:20s} {per / 1e3:6.2f} "
+              f"{100 * tot / wall:5.1f} {tfs:6.1f} {gbs:6.0f}")
+    return rows, wall
+
+
+if __name__ == "__main__":
+    target = sys.argv[1] if len(sys.argv) > 1 else tempfile.mkdtemp(
+        prefix="xc_trace_")
+    model = sys.argv[2] if len(sys.argv) > 2 else "Xception"
+    size = int(sys.argv[3]) if len(sys.argv) > 3 else 299
+    t0 = time.time()
+    capture(target, model=model, size=size)
+    parse(target)
+    print(f"total {time.time() - t0:.0f}s (trace in {target})")

@@ -1,0 +1,292 @@
+"""Pallas depthwise/sepconv probes vs XLA grouped conv (r4, VERDICT #1).
+
+Xception's cost is depthwise-separable convs: the r4 micro shootout
+(xception_variants.py) measured the pointwise 1x1s at 84.7% MFU and the
+3x3 depthwise at ~1.93 TFLOP/s effective VPU rate, with block time ~=
+dw time + pw time. The depthwise can't use the MXU (9-tap per-channel
+stencil), so the only kernel-level questions are:
+
+  1. What is the VPU's actual ceiling? (`fma9` — nine masked FMAs on a
+     resident bf16 tile, no shifts: an upper bound for any 3x3 stencil)
+  2. Do the row shifts (sublane relayouts) eat the gain? (`dw2d` — the
+     real depthwise on a 2D (B*H*W, C) layout: w-shifts are +-1-row
+     rolls, h-shifts +-19-row rolls, masks kill cross-image rows)
+  3. Does fusing dw into the pw matmul (one VMEM residency, one HBM
+     round trip) beat XLA's dw-then-pw? (`sep2d`)
+
+Shapes: Xception middle flow, b128 19x19x728 bf16 (the flagship's worst
+segment: 15.2 of 32.1 ms, xception_segments.py).
+
+Run: python experiments/pallas_probe.py
+"""
+
+import functools
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.experimental import pallas as pl  # noqa: E402
+from jax.experimental.pallas import tpu as pltpu  # noqa: E402
+
+B, H, W, C = 128, 19, 19, 728
+P = H * W                 # 361 positions per image
+P_PAD = 368               # rows per image, padded %8 (Mosaic wants
+                          # sublane-divisible block rows; 7 dead rows/img)
+BT = 2                    # images per grid step (block ~1 MB: VMEM-safe
+                          # with Mosaic's double buffering)
+R = BT * P_PAD            # rows per block
+GRID = B // BT
+
+DW_FLOPS_APP = P * C * 9 * 2          # one dw application, per image
+PW_FLOPS_APP = P * C * C * 2          # one pw application, per image
+
+
+def _row_coords(r):
+    rows = jax.lax.broadcasted_iota(jnp.int32, (r, 1), 0)
+    p = rows % P_PAD
+    return p // W, p % W  # h, w per row (p >= 361: dead pad rows)
+
+
+def pad_rows(x):
+    """(B, H, W, C) -> (B*P_PAD, C): image positions row-major, each
+    image padded to P_PAD rows so any BT block is sublane-aligned."""
+    b = x.shape[0]
+    flat = x.reshape(b, P, C)
+    out = np.zeros((b, P_PAD, C), flat.dtype)
+    out[:, :P] = flat
+    return out.reshape(b * P_PAD, C)
+
+
+def unpad_rows(x2, b):
+    return np.asarray(x2).reshape(b, P_PAD, C)[:, :P].reshape(b, H, W, C)
+
+
+# -- probe 1: VPU ceiling (9 FMAs, no shifts) --------------------------------
+
+def _fma9_kernel(x_ref, k_ref, o_ref):
+    x = x_ref[:]
+    acc = x * k_ref[0:1, :]
+    for i in range(1, 9):
+        acc += x * k_ref[i:i + 1, :]
+    o_ref[:] = acc
+
+
+def fma9(x2d, k9):
+    return pl.pallas_call(
+        _fma9_kernel,
+        grid=(GRID,),
+        in_specs=[
+            pl.BlockSpec((R, C), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((9, C), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((R, C), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(x2d.shape, x2d.dtype),
+    )(x2d, k9)
+
+
+# -- probe 2: real depthwise on the 2D layout --------------------------------
+
+def _dw_rows(x, k_ref, relu_in=False):
+    """3x3 SAME depthwise on a (R, C) block holding BT images of (19,19)
+    positions row-major. Shifts are circular rolls; masks (computed from
+    the row index) zero rows whose source crossed an image/W/H edge —
+    circular wrap rows are exactly the masked ones."""
+    if relu_in:
+        x = jnp.maximum(x, 0)
+    rows = x.shape[0]
+    h, w = _row_coords(rows)
+    zero = jnp.zeros((), x.dtype)
+
+    def shift_rows(a, s):
+        """a[r] <- a[r+s], zero-filled (Mosaic bf16 has no rotate; static
+        slice+concat lowers to sublane relayout copies)."""
+        if s == 0:
+            return a
+        pad = jnp.zeros((abs(s), a.shape[1]), a.dtype)
+        if s > 0:
+            return jnp.concatenate([a[s:], pad], axis=0)
+        return jnp.concatenate([pad, a[:s]], axis=0)
+
+    # One combined row shift per tap (19*dy + dx): row-major positions make
+    # the (dy, dx) neighbor a fixed row offset; masks kill rows whose
+    # source crossed an image/H/W edge (incl. the dead pad rows — a source
+    # in p>=361 only reaches dests with h==18 or itself dead, both
+    # masked). Keeps live VMEM to ~3 tiles.
+    acc = None
+    for j, (dy, dx) in enumerate(
+            (dy, dx) for dy in (-1, 0, 1) for dx in (-1, 0, 1)):
+        valid = ((h + dy >= 0) & (h + dy <= H - 1)
+                 & (w + dx >= 0) & (w + dx <= W - 1))
+        t = jnp.where(valid, shift_rows(x, W * dy + dx),
+                      zero) * k_ref[j:j + 1, :]
+        acc = t if acc is None else acc + t
+    return acc
+
+
+def _dw2d_kernel(x_ref, k_ref, o_ref):
+    o_ref[:] = _dw_rows(x_ref[:], k_ref)
+
+
+def dw2d(x2d, k9):
+    return pl.pallas_call(
+        _dw2d_kernel,
+        grid=(GRID,),
+        in_specs=[
+            pl.BlockSpec((R, C), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((9, C), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((R, C), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(x2d.shape, x2d.dtype),
+    )(x2d, k9)
+
+
+# -- probe 3: fused relu->dw->pw->scale/shift --------------------------------
+
+def _sep2d_kernel(x_ref, k_ref, pw_ref, sc_ref, sh_ref, o_ref):
+    t = _dw_rows(x_ref[:], k_ref, relu_in=True)
+    y = jnp.dot(t, pw_ref[:], preferred_element_type=jnp.float32)
+    y = y * sc_ref[0:1, :] + sh_ref[0:1, :]
+    o_ref[:] = y.astype(o_ref.dtype)
+
+
+def sep2d(x2d, k9, pwk, scale, shift):
+    return pl.pallas_call(
+        _sep2d_kernel,
+        grid=(GRID,),
+        in_specs=[
+            pl.BlockSpec((R, C), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((9, C), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((C, C), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, C), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, C), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((R, C), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(x2d.shape, x2d.dtype),
+        cost_estimate=pl.CostEstimate(
+            flops=BT * (DW_FLOPS_APP + PW_FLOPS_APP) * GRID,
+            bytes_accessed=2 * x2d.size * 2,
+            transcendentals=0,
+        ),
+    )(x2d, k9, pwk, scale, shift)
+
+
+# -- XLA references at the same shapes ---------------------------------------
+
+DIMS = ("NHWC", "HWIO", "NHWC")
+
+
+def xla_dw(x4d, k4):
+    return jax.lax.conv_general_dilated(
+        x4d, k4, (1, 1), "SAME", dimension_numbers=DIMS,
+        feature_group_count=C)
+
+
+def xla_sep(x4d, k4, pwk4, scale, shift):
+    t = xla_dw(jnp.maximum(x4d, 0), k4)
+    y = jax.lax.conv_general_dilated(t, pwk4, (1, 1), "SAME",
+                                     dimension_numbers=DIMS)
+    return y * scale[0] + shift[0]
+
+
+# -- correctness + timing ----------------------------------------------------
+
+def check_correct():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(2, H, W, C)).astype(np.float32)
+    k = rng.normal(size=(3, 3, C)).astype(np.float32) * 0.2
+    x2d = jnp.asarray(pad_rows(x), jnp.bfloat16)
+    k9 = jnp.asarray(k.reshape(9, C), jnp.bfloat16)
+
+    global GRID
+    g0 = GRID
+    GRID = 1
+    try:
+        got = unpad_rows(np.asarray(dw2d(x2d, k9), np.float32), 2)
+    finally:
+        GRID = g0
+    want = np.asarray(xla_dw(
+        jnp.asarray(x, jnp.bfloat16),
+        jnp.asarray(k.reshape(3, 3, 1, C), jnp.bfloat16)), np.float32)
+    err = np.abs(got - want).max() / (np.abs(want).max() + 1e-6)
+    print(f"dw2d vs XLA grouped conv: rel err {err:.4f}", flush=True)
+    assert err < 0.02, err
+
+
+def make_chain_measurer(fn, x0, ks=(2, 34), repeats=4):
+    """Time `fn` by CHAINING it on its own output inside one XLA program
+    (shape-preserving fns only): a loop-carried array dependence with zero
+    harness overhead — make_slope_measurer's f32 perturbation add+cast
+    costs ~1 ms/iter at this operand size, swamping sub-ms kernels."""
+    xd = jax.device_put(x0)
+
+    @functools.partial(jax.jit, static_argnums=1)
+    def chain(a, k):
+        a = jax.lax.fori_loop(0, k, lambda i, t: fn(t), a)
+        return jnp.sum(a[:1, :8].astype(jnp.float32))
+
+    for k in ks:
+        jax.device_get(chain(xd, k))
+
+    def measure():
+        res = {}
+        for k in ks:
+            ts = []
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                jax.device_get(chain(xd, k))
+                ts.append(time.perf_counter() - t0)
+            res[k] = min(ts)
+        return (res[ks[1]] - res[ks[0]]) / (ks[1] - ks[0])
+
+    return measure
+
+
+def measure(name, fn, x0, flops_app, apps=1):
+    m = make_chain_measurer(fn, x0)
+    per_iter = min(m() for _ in range(3))
+    ips = B / per_iter
+    us_app = per_iter / apps * 1e6
+    print(f"{name:10s} {ips:10.1f} img/s  {us_app:7.1f} us/app  "
+          f"{flops_app * ips / 1e12:6.2f} TFLOP/s", flush=True)
+    return us_app
+
+
+def main():
+    check_correct()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(B, H, W, C)).astype(np.float32)
+    x2 = pad_rows(x)
+    k = (rng.normal(size=(9, C)).astype(np.float32) * 0.2)
+    pwk = rng.normal(size=(C, C)).astype(np.float32) * 0.03
+    sc = np.abs(rng.normal(size=(1, C)).astype(np.float32))
+    sh = rng.normal(size=(1, C)).astype(np.float32) * 0.01
+    bf = functools.partial(jnp.asarray, dtype=jnp.bfloat16)
+    v = {"k9": bf(k), "pw": bf(pwk), "sc": bf(sc), "sh": bf(sh),
+         "k4": bf(k.reshape(3, 3, 1, C)), "pw4": bf(pwk.reshape(1, 1, C, C))}
+    x2b = np.asarray(x2, np.float32).astype(jnp.bfloat16)
+    x4b = x.astype(jnp.bfloat16)
+
+    measure("fma9", lambda xx: fma9(xx, v["k9"]), x2b, DW_FLOPS_APP)
+    measure("dw2d", lambda xx: dw2d(xx, v["k9"]), x2b, DW_FLOPS_APP)
+    measure("xla-dw", lambda xx: xla_dw(xx, v["k4"]), x4b, DW_FLOPS_APP)
+    measure("sep2d", lambda xx: sep2d(xx, v["k9"], v["pw"], v["sc"],
+                                      v["sh"]), x2b,
+            DW_FLOPS_APP + PW_FLOPS_APP)
+    measure("xla-sep", lambda xx: xla_sep(xx, v["k4"], v["pw4"], v["sc"],
+                                          v["sh"]), x4b,
+            DW_FLOPS_APP + PW_FLOPS_APP)
+
+
+if __name__ == "__main__":
+    t0 = time.time()
+    main()
+    print(f"total {time.time() - t0:.0f}s")

@@ -122,33 +122,14 @@ def micro():
 
 
 def pallas():
-    """Fused Pallas kernels vs the grouped-conv block (TPU)."""
-    from sparkdl_tpu.ops import fused_middle_block, fused_sepconv
+    """Pallas kernels vs XLA at the same shapes — delegates to
+    ``experiments/pallas_probe.py`` (r4). Measured outcome: XLA's grouped
+    depthwise beats the Pallas formulations 3-6x and the fused Pallas
+    sepconv loses 1.6x to XLA's dw+pw pair; no sparkdl_tpu.ops module
+    ships (the ceiling analysis is in docs/PERF.md)."""
+    from experiments import pallas_probe
 
-    rng = np.random.default_rng(0)
-    x = rng.normal(size=(B, H, W, C)).astype(np.float32)
-    variables = make_params(rng)
-
-    def as_p3(v):
-        return [(v[f"dw{i}"], v[f"pw{i}"].reshape(1, 1, C, C), v[f"b{i}"])
-                for i in range(3)]
-
-    def block_sep(v, xx):
-        xx = xx.astype(jnp.bfloat16)
-        res = xx
-        for i, (dw, pwk, b) in enumerate(as_p3(v)):
-            r = res if i == 2 else None
-            xx = fused_sepconv(xx, dw, pwk, b, relu_in=True, residual=r)
-        return xx
-
-    def block_fused(v, xx):
-        return fused_middle_block(xx.astype(jnp.bfloat16), as_p3(v))
-
-    measure("blk-3xsep", block_sep, variables, x, BLOCK_FLOPS)
-    measure("blk-fused", block_fused, variables, x, BLOCK_FLOPS)
-    measure("block-grp", lambda v, xx: block(v, xx.astype(jnp.bfloat16),
-                                             dw_grouped),
-            variables, x, BLOCK_FLOPS)
+    pallas_probe.main()
 
 
 def full():

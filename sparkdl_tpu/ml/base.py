@@ -155,6 +155,23 @@ class Pipeline(Estimator):
             for s in that.getStages()])
         return that
 
+    # -- persistence: unfitted pipeline (VERDICT r3 #6) ----------------------
+
+    def save(self, path: str) -> None:
+        """Persist the UNFITTED pipeline — one subdirectory per stage
+        (transformers and unfitted estimators alike), so a training
+        pipeline can be saved, reloaded, and then fit (Spark MLWritable
+        covered unfitted Pipelines too, SURVEY.md §2.1)."""
+        from sparkdl_tpu.ml import persistence as P
+
+        P.save_stage_dirs(self, self.getStages(), path)
+
+    @classmethod
+    def _load_from(cls, path: str, meta):
+        from sparkdl_tpu.ml import persistence as P
+
+        return cls(stages=P.load_stage_dirs(path, meta))
+
 
 class PipelineModel(Model):
     """The fitted pipeline: a chain of transformers."""
@@ -177,28 +194,12 @@ class PipelineModel(Model):
     # -- persistence: one subdirectory per stage -----------------------------
 
     def save(self, path: str) -> None:
-        import os
-
         from sparkdl_tpu.ml import persistence as P
 
-        os.makedirs(path, exist_ok=True)
-        stage_dirs = []
-        for i, stage in enumerate(self.stages):
-            if not hasattr(stage, "save"):
-                raise ValueError(
-                    f"Pipeline stage {i} ({type(stage).__name__}) does not "
-                    "support save()")
-            sub = f"stage_{i:03d}_{type(stage).__name__}"
-            stage.save(os.path.join(path, sub))
-            stage_dirs.append(sub)
-        P.write_metadata(path, self, {"stage_dirs": stage_dirs}, {})
+        P.save_stage_dirs(self, self.stages, path)
 
     @classmethod
     def _load_from(cls, path: str, meta):
-        import os
-
         from sparkdl_tpu.ml import persistence as P
 
-        stages = [P.load(os.path.join(path, sub))
-                  for sub in meta["params"]["stage_dirs"]]
-        return cls(stages)
+        return cls(P.load_stage_dirs(path, meta))

@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sparkdl_tpu.core import profiling
 from sparkdl_tpu.core.model_function import ModelFunction
 from sparkdl_tpu.image import imageIO
 from sparkdl_tpu.ml.base import Estimator, Model
@@ -51,7 +52,10 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
     kerasFitParams = Param(
         "KerasImageFileEstimator", "kerasFitParams",
         "fit options: {'epochs': int, 'batch_size': int, "
-        "'learning_rate': float, 'shuffle': bool, 'seed': int, 'streaming': bool, 'mixed_precision': bool}",
+        "'learning_rate': float, 'shuffle': bool, 'seed': int, "
+        "'streaming': bool, 'mixed_precision': bool, "
+        "'shuffle_buffer': int (windowed-shuffle pool depth in batches, "
+        "streaming path; default 4)}",
         typeConverter=TypeConverters.identity)
 
     @keyword_only
@@ -200,6 +204,14 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         buffer across partitions (an EXACT global permutation requires the
         collected path, ``streaming=False``); with ``shuffle=False`` the
         batch sequence is identical to the collected path's.
+
+        Multi-host (SURVEY.md §2.5/§3.5, HorovodRunner parity): when the
+        process group spans several hosts, each host streams+decodes ONLY
+        its round-robin share of the partitions and emits LOCAL batches of
+        ``batch_size / process_count``; ``Trainer.stage_batch`` assembles
+        the global sharded array from the per-process shards. Hosts stay
+        in lockstep via a per-batch allgather (the epoch ends for everyone
+        when the first host runs dry, dropping at most the tail).
         """
         from sparkdl_tpu.core.mesh import data_axis_size, pad_to_multiple
         from sparkdl_tpu.train.trainer import Trainer
@@ -212,16 +224,35 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         seed = int(fit_params.get("seed", 0))
         lr = fit_params.get("learning_rate")
         mesh = self.resolveMesh()
+        num_proc = jax.process_count()
         multiple = 1
         if mesh is not None:
             multiple = data_axis_size(mesh)
             batch_size = pad_to_multiple(batch_size, multiple)
+        if num_proc > 1:
+            if mesh is None:
+                raise ValueError(
+                    "multi-host fit requires a mesh (the data axis carries "
+                    "the per-host shards)")
+            # every host contributes an equal local slice of each global
+            # batch; the data axis is a multiple of process_count on any
+            # jax.distributed topology, so this divides exactly
+            batch_size //= num_proc
+            multiple //= num_proc
         loaded, target_size = self._loaded_frame(dataset)
         frame = loaded.select(_LOADED_COL, self.getLabelCol())
+        if num_proc > 1 and frame.numPartitions < num_proc:
+            raise ValueError(
+                f"multi-host fit needs at least one partition per process: "
+                f"dataset has {frame.numPartitions} partitions for "
+                f"{num_proc} processes — repartition the DataFrame")
         stream = _PartitionBatchStream(
             frame, _LOADED_COL, self.getLabelCol(), target_size,
             str(mf.input_spec.dtype), batch_size, multiple, shuffle, seed,
-            self._label_preparer(mf))
+            self._label_preparer(mf),
+            shuffle_buffer=int(fit_params.get("shuffle_buffer", 4)),
+            process_id=jax.process_index() if num_proc > 1 else None,
+            num_processes=num_proc if num_proc > 1 else None)
         trainer, state = Trainer.from_model_function(
             mf, loss=self.getKerasLoss(), optimizer=self.getKerasOptimizer(),
             learning_rate=lr, mesh=mesh,
@@ -294,19 +325,72 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         x, y = self._collect_arrays(dataset)
         return self._fit_on_arrays(x, y)
 
+    # -- persistence (unfitted estimator; VERDICT r3 #6) ---------------------
+
+    def save(self, path: str) -> None:
+        """Persist the UNFITTED estimator: params metadata + the Keras
+        model artifact (self-contained — an in-memory ``model`` serializes
+        via Keras, a ``modelFile`` is copied in). ``load`` then ``fit``
+        reproduces the model fitting the original would produce (training
+        is deterministic in the fit-param seed)."""
+        import os
+
+        from sparkdl_tpu.ml import persistence as P
+
+        P.check_no_custom_loader(self)
+        os.makedirs(path, exist_ok=True)
+        params = P.jsonable_params(self, skip=("mesh", "model", "modelFile"))
+        artifact = P.save_keras_artifact(self, path)
+        if artifact is None:
+            raise ValueError("set either model or modelFile before save()")
+        P.write_metadata(path, self, params, {"keras_model": artifact})
+
+    @classmethod
+    def _load_from(cls, path: str, meta):
+        import os
+
+        inst = cls(**meta["params"])
+        inst.setModelFile(os.path.join(path, meta["artifacts"]["keras_model"]))
+        return inst
+
     def fitMultiple(self, dataset, paramMaps) -> Iterator[Tuple[int, Model]]:
         """Param-map search sharing ONE image decode pass (§3.3 parity:
-        the reference collected features once, then looped over maps)."""
-        base_x, base_y = self._collect_arrays(dataset)
+        the reference collected features once, then looped over maps).
+
+        Decode-sharing policy (VERDICT r3 #7): by default the dataset is
+        decoded ONCE into a host cache shared by every map — the fastest
+        HPO path, at the §3.3 collect-cliff memory cost. A map (or the
+        base estimator) that sets ``kerasFitParams={'streaming': True}``
+        opts that fit out of the cache: it streams partitions with bounded
+        memory instead (decode repeats per fit+epoch — the explicit
+        time-for-memory trade for datasets that don't fit on the host).
+        The collect runs lazily, only when the first cache-sharing map
+        trains, so an all-streaming search never materializes the dataset.
+        """
         estimator = self.copy()
+
+        def _map_streams(param_map) -> bool:
+            fp = estimator.copy(param_map).getKerasFitParams()
+            return bool(fp.get("streaming", False))
 
         class _Iter:
             def __init__(self) -> None:
                 self._lock = threading.Lock()
+                # separate lock: the (long) one-time collect must not block
+                # other threads from taking indices / starting streaming
+                # fits that need no cache
+                self._cache_lock = threading.Lock()
                 self._next = 0
+                self._cache: Optional[Tuple[np.ndarray, np.ndarray]] = None
 
             def __iter__(self):
                 return self
+
+            def _collected(self):
+                with self._cache_lock:
+                    if self._cache is None:
+                        self._cache = estimator._collect_arrays(dataset)
+                    return self._cache
 
             def __next__(self):
                 with self._lock:
@@ -314,8 +398,13 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
                     if index >= len(paramMaps):
                         raise StopIteration
                     self._next += 1
-                fitted = estimator.copy(paramMaps[index])._fit_on_arrays(
-                    base_x, base_y)
+                if _map_streams(paramMaps[index]):
+                    fitted = estimator.copy(
+                        paramMaps[index])._fit_streaming(dataset)
+                else:
+                    base_x, base_y = self._collected()
+                    fitted = estimator.copy(paramMaps[index])._fit_on_arrays(
+                        base_x, base_y)
                 return index, fitted
 
         return _Iter()
@@ -340,7 +429,10 @@ class _PartitionBatchStream:
     def __init__(self, frame, image_col: str, label_col: str,
                  target_size, dtype: str, batch_size: int, multiple: int,
                  shuffle: bool, seed: int,
-                 prepare_labels: Callable[[np.ndarray], np.ndarray]) -> None:
+                 prepare_labels: Callable[[np.ndarray], np.ndarray],
+                 shuffle_buffer: int = 4,
+                 process_id: Optional[int] = None,
+                 num_processes: Optional[int] = None) -> None:
         self._frame = frame
         self._image_col = image_col
         self._label_col = label_col
@@ -351,10 +443,44 @@ class _PartitionBatchStream:
         self._shuffle = shuffle
         self._seed = seed
         self._prepare_labels = prepare_labels
+        self._shuffle_buffer = max(1, shuffle_buffer)
+        self._process_id = process_id
+        self._num_processes = num_processes
         self._epoch = 0
         self.batches_last_epoch: Optional[int] = None
 
+    @property
+    def _multihost(self) -> bool:
+        return bool(self._num_processes and self._num_processes > 1)
+
+    def _lockstep(self, gen):
+        """Keep hosts emitting the same batch COUNT: before every yield,
+        all processes agree (allgather) whether everyone still has a next
+        batch; the epoch ends globally when the first host runs dry. One
+        tiny host-collective per batch — the analog of the per-step
+        barrier Horovod's allreduce imposed anyway (SURVEY.md §3.5)."""
+        from jax.experimental import multihost_utils
+
+        it = iter(gen)
+        while True:
+            try:
+                nxt = next(it)
+                have = 1
+            except StopIteration:
+                nxt = None
+                have = 0
+            counts = multihost_utils.process_allgather(
+                np.asarray([have], dtype=np.int32))
+            if int(np.min(counts)) == 0:
+                return
+            yield nxt
+
     def _partition_arrays(self, part) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        with profiling.annotate("sparkdl.stage"):
+            return self._partition_arrays_inner(part)
+
+    def _partition_arrays_inner(self, part
+                                ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
         idx = part.schema.get_field_index(self._image_col)
         col = part.column(idx)
         labels = part.column(part.schema.get_field_index(self._label_col))
@@ -381,10 +507,32 @@ class _PartitionBatchStream:
             # custom loaders may emit off-size structs; batch-resize here
             x = imageIO.resizeBatchArray(x, tuple(self._target_size))
         if x.dtype != np.dtype(self._dtype):
-            x = x.astype(self._dtype)
+            if (x.dtype == np.uint8
+                    and np.dtype(self._dtype) == np.dtype(np.float32)):
+                # keep uint8: Trainer.stage_batch transfers it raw and
+                # casts to FLOAT32 on device (exact for 0-255) — 4x less
+                # host->device traffic on the training hot loop. f32 only:
+                # other float input dtypes must cast host-side so the
+                # staged dtype matches the collected path exactly.
+                pass
+            else:
+                x = x.astype(self._dtype)
         return x, self._prepare_labels(y)
 
     def __iter__(self):
+        if self._multihost:
+            # lockstep wrapper counts the GLOBAL epoch length; the local
+            # generator's own count is corrected afterwards
+            gen = self._lockstep(self._iter_local())
+            emitted = 0
+            for item in gen:
+                emitted += 1
+                yield item
+            self.batches_last_epoch = emitted
+            return
+        yield from self._iter_local()
+
+    def _iter_local(self):
         epoch = self._epoch
         self._epoch += 1
         bs = self._batch_size
@@ -392,10 +540,12 @@ class _PartitionBatchStream:
         order = None
         # Windowed shuffle (tf.data-style buffer): partitions are visited
         # in a fresh per-epoch order and rows mix across a pool of
-        # ~4 batches + 1 partition before each emit — bounded memory,
-        # breaks class-clustered partition layouts. An EXACT global
-        # permutation needs the collected path (streaming=False).
-        pool_cap = bs * 4 if self._shuffle else 0
+        # ``shuffle_buffer`` batches + 1 partition before each emit —
+        # bounded memory, breaks class-clustered partition layouts. Deepen
+        # via kerasFitParams['shuffle_buffer'] (VERDICT r3 weak #4); an
+        # EXACT global permutation needs the collected path
+        # (streaming=False).
+        pool_cap = bs * self._shuffle_buffer if self._shuffle else 0
         if self._shuffle:
             order = np.random.default_rng(
                 (self._seed, epoch)).permutation(self._frame.numPartitions)
@@ -410,7 +560,9 @@ class _PartitionBatchStream:
             perm = rng.permutation(len(pool_x))
             return pool_x[perm], pool_y[perm]
 
-        for part in self._frame.streamPartitions(order=order):
+        for part in self._frame.streamPartitions(
+                order=order, process_id=self._process_id,
+                num_processes=self._num_processes):
             arrays = self._partition_arrays(part)
             if arrays is None:
                 continue
@@ -434,7 +586,11 @@ class _PartitionBatchStream:
             for i in range(0, usable, bs):
                 emitted += 1
                 yield pool_x[i:i + bs], pool_y[i:i + bs]
-            if emitted == 0:
+            if emitted == 0 and not self._multihost:
+                # single-host small-dataset fallback: one sub-batch, rounded
+                # to the mesh multiple. Multi-host skips it — unequal host
+                # shard shapes can't assemble one global array; the
+                # lockstep layer ends the epoch consistently instead.
                 n = (len(pool_x) // self._multiple) * self._multiple
                 if n == 0:
                     raise ValueError(

@@ -15,6 +15,14 @@ for TPU inference:
   to float reassociation); the MXU sees 176-1152 output lanes instead of
   three 48-448 passes, and the block input is read from HBM once instead
   of three times.
+- **Pool-branch as conv — tried and REVERTED** (r4, measured): the
+  ``avg_pool(3x3) -> 1x1 projection`` branch rewrites exactly as a dense
+  3x3 conv (projection at all 9 taps + positional edge-count scale),
+  which moves the HBM-roofline-bound ``reduce_window`` onto the MXU.
+  Same-process A/B measured it 14% SLOWER whole-model (8,490 vs 9,815
+  img/s): the 9x FLOPs on the small-output-channel projections (32-192)
+  outweigh the pool's one HBM round trip. ``_cb_pool`` keeps the exact
+  pool+project composition; see docs/PERF.md.
 
 Parity with the module is asserted by ``tests/models/test_inception_fast.py``
 (f32 CPU equality) and the call order mirrors ``inception.py`` cb-index for
@@ -72,6 +80,15 @@ def _cb(variables, x, idx, strides=(1, 1), padding="SAME"):
     return _conv(x, k, b, strides, padding)
 
 
+def _cb_pool(variables, x, idx):
+    """Inception pool branch: ``avg_pool_same(x)`` then 1x1 ConvBN.
+
+    The pool-as-dense-3x3-conv rewrite was measured 14% slower
+    whole-model (see module docstring) — keep the straightforward form.
+    """
+    return _cb(variables, avg_pool_same(x), idx)
+
+
 def _cb_fused(variables, x, idxs: Sequence[int]) -> Tuple[jax.Array, ...]:
     """The parallel 1x1 ConvBN heads ``idxs`` as ONE conv; returns splits."""
     folded = [_folded(variables, i, x.dtype) for i in idxs]
@@ -113,8 +130,7 @@ def inception_v3_fast_apply(variables: Any, x: jax.Array,
         b5 = _cb(variables, b5, idx + 2)                    # 5x5
         b3 = _cb(variables, b3, idx + 4)
         b3 = _cb(variables, b3, idx + 5)
-        bp = avg_pool_same(x)
-        bp = _cb(variables, bp, idx + 6)
+        bp = _cb_pool(variables, x, idx + 6)
         x = jnp.concatenate([b1, b5, b3, bp], axis=-1)
         idx += 7
 
@@ -136,8 +152,7 @@ def inception_v3_fast_apply(variables: Any, x: jax.Array,
         bd = _cb(variables, bd, idx + 6)                    # 1x7
         bd = _cb(variables, bd, idx + 7)                    # 7x1
         bd = _cb(variables, bd, idx + 8)                    # 1x7
-        bp = avg_pool_same(x)
-        bp = _cb(variables, bp, idx + 9)
+        bp = _cb_pool(variables, x, idx + 9)
         x = jnp.concatenate([b1, b7, bd, bp], axis=-1)
         idx += 10
 
@@ -161,8 +176,7 @@ def inception_v3_fast_apply(variables: Any, x: jax.Array,
         bda = _cb(variables, bd, idx + 6)                   # 1x3
         bdb = _cb(variables, bd, idx + 7)                   # 3x1
         bd = jnp.concatenate([bda, bdb], axis=-1)
-        bp = avg_pool_same(x)
-        bp = _cb(variables, bp, idx + 8)
+        bp = _cb_pool(variables, x, idx + 8)
         x = jnp.concatenate([b1, b3, bd, bp], axis=-1)
         idx += 9
 

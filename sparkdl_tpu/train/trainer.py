@@ -293,16 +293,23 @@ class Trainer:
         def stage_batch(arr):
             """Host batch → device array sharded over ``data``.
 
-            Multi-host (SURVEY.md §5.8, HorovodRunner parity): every
-            process passes its LOCAL rows; the global array is assembled
-            from the process-local shards — the per-host input feeding the
+            uint8 batches (decoded images) transfer raw and cast to f32
+            ON DEVICE — 4x less host→device traffic than casting on the
+            host (the cast is exact for 0-255 integers). Multi-host
+            (SURVEY.md §5.8, HorovodRunner parity): every process passes
+            its LOCAL rows; the global array is assembled from the
+            process-local shards — the per-host input feeding the
             reference achieved with one Spark partition per worker.
             """
             arr = np.asarray(arr)
             if multihost:
                 sharding = batch_sharding(self.mesh, arr.ndim)
-                return jax.make_array_from_process_local_data(sharding, arr)
-            return jnp.asarray(arr)
+                out = jax.make_array_from_process_local_data(sharding, arr)
+            else:
+                out = jnp.asarray(arr)
+            if out.dtype == jnp.uint8:
+                out = out.astype(jnp.float32)
+            return out
 
         # Exact resume: the loop replays the (deterministic) batch stream and
         # skips the first `state.step` positions — mid-epoch restarts land on

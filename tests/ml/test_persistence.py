@@ -188,3 +188,95 @@ def test_multi_io_transformer_roundtrip(rng, tmp_path):
     assert isinstance(t2.getModelFunction().input_spec, dict)
     got = _vectors(t2.transform(df), "s")
     np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+
+
+# -- unfitted estimator / pipeline persistence (VERDICT r3 #6) ---------------
+
+
+@pytest.fixture
+def labeled_uri_df(rng, tmp_path):
+    from PIL import Image
+
+    rows = []
+    for i in range(16):
+        label = i % 2
+        arr = rng.integers(0, 40, size=(8, 8, 3), dtype=np.uint8)
+        arr[..., label] += 180
+        p = tmp_path / f"img_{i}.png"
+        Image.fromarray(arr).save(p)
+        rows.append({"uri": str(p), "label": label})
+    return DataFrame.fromRows(rows, numPartitions=2)
+
+
+def _tiny_keras_cnn():
+    keras = pytest.importorskip("keras")
+    from keras import layers
+
+    return keras.Sequential([
+        keras.Input((8, 8, 3)),
+        layers.Rescaling(1 / 255.0),
+        layers.Flatten(),
+        layers.Dense(2, activation="softmax")])
+
+
+def _unfitted_estimator():
+    return KerasImageFileEstimator(
+        inputCol="uri", outputCol="preds", labelCol="label",
+        model=_tiny_keras_cnn(), kerasOptimizer="sgd",
+        kerasLoss="categorical_crossentropy",
+        kerasFitParams={"epochs": 2, "batch_size": 8,
+                        "learning_rate": 0.05, "shuffle": True, "seed": 7})
+
+
+def test_unfitted_estimator_roundtrip_fit(labeled_uri_df, tmp_path):
+    """save -> load -> fit == fitting the original (same seed, same data)."""
+    est = _unfitted_estimator()
+    est.save(str(tmp_path / "est"))
+    est2 = load(str(tmp_path / "est"))
+    assert isinstance(est2, KerasImageFileEstimator)
+    assert est2.getKerasOptimizer() == "sgd"
+    assert est2.getKerasFitParams()["seed"] == 7
+    want = _vectors(est.fit(labeled_uri_df).transform(labeled_uri_df), "preds")
+    got = _vectors(est2.fit(labeled_uri_df).transform(labeled_uri_df), "preds")
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_unfitted_estimator_modelfile_roundtrip(labeled_uri_df, tmp_path):
+    """A modelFile-backed estimator saves self-contained: the artifact is a
+    copy, so deleting the original file does not break the reloaded one."""
+    import os
+
+    src = str(tmp_path / "src_model.keras")
+    _tiny_keras_cnn().save(src)
+    est = KerasImageFileEstimator(
+        inputCol="uri", outputCol="preds", labelCol="label", modelFile=src,
+        kerasLoss="categorical_crossentropy",
+        kerasFitParams={"epochs": 1, "batch_size": 8, "seed": 3})
+    est.save(str(tmp_path / "est2"))
+    os.remove(src)
+    est2 = load(str(tmp_path / "est2"))
+    model = est2.fit(labeled_uri_df)
+    assert len(model.transform(labeled_uri_df).collect()) == 16
+
+
+def test_unfitted_estimator_save_without_model_raises(tmp_path):
+    est = KerasImageFileEstimator(inputCol="uri", outputCol="p",
+                                  labelCol="label")
+    with pytest.raises(ValueError, match="model or modelFile"):
+        est.save(str(tmp_path / "bad"))
+
+
+def test_unfitted_pipeline_roundtrip_fit(labeled_uri_df, tmp_path):
+    """Unfitted Pipeline(stages=[estimator]) round-trips and then fits."""
+    from sparkdl_tpu.ml import Pipeline
+
+    pipe = Pipeline(stages=[_unfitted_estimator()])
+    pipe.save(str(tmp_path / "pipe"))
+    pipe2 = load(str(tmp_path / "pipe"))
+    assert isinstance(pipe2, Pipeline)
+    assert len(pipe2.getStages()) == 1
+    want = _vectors(pipe.fit(labeled_uri_df).transform(labeled_uri_df),
+                    "preds")
+    got = _vectors(pipe2.fit(labeled_uri_df).transform(labeled_uri_df),
+                   "preds")
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)

@@ -1,0 +1,81 @@
+"""Worker for the 2-process multi-host `KerasImageFileEstimator.fit(df)`
+test (VERDICT r3 #4 / SURVEY.md §2.5, §3.5).
+
+Each of two processes owns 4 virtual CPU devices (8 global), joins the
+process group via the SPARKDL_* env triple, and calls the PUBLIC ML API:
+``estimator.fit(image_dataframe)``. The estimator's streaming path must
+shard partitions per-process (each host decodes only its round-robin
+share), emit local batches, and let Trainer assemble the global arrays —
+process 0 writes the fitted params for comparison with a single-process
+fit of the same DataFrame.
+
+Usage: python _multihost_estimator_worker.py <data_dir> <out_dir>
+(data_dir holds manifest.json {rows, model_file} written by the test)
+"""
+
+import json
+import os
+import sys
+
+if __name__ == "__main__":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=4")
+
+import jax  # noqa: E402
+
+if __name__ == "__main__":
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from sparkdl_tpu.core.mesh import MeshConfig, make_mesh  # noqa: E402
+from sparkdl_tpu.engine.dataframe import DataFrame  # noqa: E402
+from sparkdl_tpu.ml import KerasImageFileEstimator  # noqa: E402
+from sparkdl_tpu.train.runner import maybe_initialize_distributed  # noqa: E402
+
+# Four partitions of 8 rows, global batch 16, shuffle=False: the global
+# batch sequence ([p0;p1], [p2;p3]) is identical between the 2-process
+# run (host0 streams p0,p2 / host1 p1,p3, each contributing local halves)
+# and a single-process streaming fit — so params must match exactly.
+NUM_PARTITIONS = 4
+GLOBAL_BATCH = 16
+
+
+def build_estimator(data_dir: str, mesh) -> "KerasImageFileEstimator":
+    with open(os.path.join(data_dir, "manifest.json")) as f:
+        manifest = json.load(f)
+    df = DataFrame.fromRows(manifest["rows"],
+                            numPartitions=NUM_PARTITIONS)
+    est = KerasImageFileEstimator(
+        inputCol="uri", outputCol="preds", labelCol="label",
+        modelFile=manifest["model_file"], kerasOptimizer="sgd",
+        kerasLoss="categorical_crossentropy", mesh=mesh,
+        kerasFitParams={"epochs": 2, "batch_size": GLOBAL_BATCH,
+                        "shuffle": False, "streaming": True,
+                        "learning_rate": 0.05})
+    return est, df
+
+
+def flat_params(model) -> np.ndarray:
+    params = jax.device_get(model.getModelFunction().variables)
+    return np.concatenate([np.ravel(leaf)
+                           for leaf in jax.tree.leaves(params)])
+
+
+def main(data_dir: str, out_dir: str) -> None:
+    assert maybe_initialize_distributed(), "SPARKDL_* env triple not set"
+    assert jax.process_count() == 2, jax.process_count()
+    mesh = make_mesh(MeshConfig(data=8))
+    est, df = build_estimator(data_dir, mesh)
+    model = est.fit(df)
+    if jax.process_index() == 0:
+        np.save(os.path.join(out_dir, "multihost_estimator_params.npy"),
+                flat_params(model))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], sys.argv[2])

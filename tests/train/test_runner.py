@@ -127,3 +127,75 @@ def test_two_process_distributed_training_matches_single(tmp_path):
     want = np.concatenate([np.ravel(leaf) for leaf in jax.tree.leaves(
         jax.device_get(state.params))])
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_two_process_estimator_fit_matches_single(tmp_path):
+    """Multi-host `.fit(df)` through the PUBLIC ML API (VERDICT r3 #4):
+    each process decodes only its round-robin partition share, emits local
+    batches, and the fitted params equal a single-process streaming fit of
+    the same DataFrame (partition sizes == local batch, shuffle=False, so
+    the global batch sequence is identical)."""
+    import json
+    import socket
+    import subprocess
+    import sys
+
+    import jax
+
+    keras = pytest.importorskip("keras")
+    from keras import layers
+    from PIL import Image
+
+    from sparkdl_tpu.core.mesh import MeshConfig, make_mesh
+
+    # deterministic data: 4 partitions x 8 rows of trivially-labeled PNGs
+    rng = np.random.default_rng(0)
+    rows = []
+    for i in range(32):
+        label = i % 2
+        arr = rng.integers(0, 40, size=(8, 8, 3), dtype=np.uint8)
+        arr[..., label] += 180
+        p = tmp_path / f"img_{i:02d}.png"
+        Image.fromarray(arr).save(p)
+        rows.append({"uri": str(p), "label": label})
+    model_file = str(tmp_path / "model.keras")
+    keras.Sequential([
+        keras.Input((8, 8, 3)), layers.Rescaling(1 / 255.0),
+        layers.Flatten(), layers.Dense(2, activation="softmax"),
+    ]).save(model_file)
+    with open(tmp_path / "manifest.json", "w") as f:
+        json.dump({"rows": rows, "model_file": model_file}, f)
+
+    worker = os.path.join(os.path.dirname(__file__),
+                          "_multihost_estimator_worker.py")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.update({
+            "SPARKDL_COORDINATOR": f"127.0.0.1:{port}",
+            "SPARKDL_NUM_PROCESSES": "2",
+            "SPARKDL_PROCESS_ID": str(pid),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, worker, str(tmp_path), str(tmp_path)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    outs = [p.communicate(timeout=420)[0] for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out.decode(errors="replace")[-3000:]
+    got = np.load(tmp_path / "multihost_estimator_params.npy")
+
+    # single-process reference: same estimator, same DataFrame, 8 local
+    # devices (this pytest process), streaming fit
+    sys.path.insert(0, os.path.dirname(worker))
+    try:
+        import _multihost_estimator_worker as w
+    finally:
+        sys.path.pop(0)
+    mesh = make_mesh(MeshConfig(data=8))
+    est, df = w.build_estimator(str(tmp_path), mesh)
+    want = w.flat_params(est.fit(df))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
