@@ -5,7 +5,7 @@ fusions the 32 ms Xception batch actually spends time in, so the ceiling
 argument (depthwise = VPU-bound, pointwise = near-MXU-peak) is checkable
 against the compiler's own schedule rather than asserted.
 
-Run: python experiments/xception_profile.py [trace_dir] [model] [size]
+Run: python experiments/fusion_profile.py [trace_dir] [model] [size]
 """
 
 import glob

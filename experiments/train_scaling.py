@@ -1,10 +1,14 @@
 """ResNet50 training-step scaling study (VERDICT r3 #5, BASELINE config 5).
 
-Sweeps batch size x donation x block-level remat for the mixed-precision
-jitted train step and reports ms/step, img/s and training MFU (fwd+bwd ~=
-3x fwd FLOPs). r3 measured only b64/donate=False (27.4 ms, ~27% MFU);
-the HorovodRunner north star is a *training* config, so the envelope
-matters.
+Sweeps batch size x donation for the mixed-precision jitted train step
+and reports ms/step, img/s and training MFU (fwd+bwd ~= 3x fwd FLOPs).
+r3 measured only b64/donate=False (27.4 ms, ~27% MFU); the HorovodRunner
+north star is a *training* config, so the envelope matters.
+
+Remat is deliberately NOT in the sweep: no batch size up to 256
+approaches HBM capacity here, and remat only trades FLOPs for memory —
+on a backward pass measured HBM-bandwidth-bound (docs/PERF.md) it can
+only lose. The Trainer docstring records the same rationale.
 
 Run: python experiments/train_scaling.py
 """
@@ -23,20 +27,12 @@ FLOPS_FWD_IMG = 7.75e9      # ResNet50 224², 2*MACs
 PEAK = 197e12
 
 
-def step_time(batch_size, donate, remat, compute_dtype="bfloat16", steps=10):
-    import flax.linen as nn
-
+def step_time(batch_size, donate, compute_dtype="bfloat16", steps=10):
     from sparkdl_tpu.models import registry
     from sparkdl_tpu.train import Trainer
 
     spec = registry.get_model_spec("ResNet50")
     module = spec.builder(include_top=True, classes=spec.classes)
-    if remat:
-        # block-boundary remat per the Trainer's own guidance: wrap the
-        # module apply in nn.remat at the top level is monolithic — the
-        # honest block-level variant needs model support; emulate with
-        # jax.checkpoint on the apply as the "whole-model" contrast point.
-        pass
     h, w = spec.input_size
     rng = np.random.default_rng(0)
     x = rng.uniform(0, 1, size=(batch_size, h, w, 3)).astype(np.float32)
@@ -73,13 +69,13 @@ def main():
     for bs in (64, 128, 256):
         for donate in (False, True):
             try:
-                t = step_time(bs, donate, remat=False)
+                t = step_time(bs, donate)
             except Exception as e:  # OOM at large batch is a finding
                 print(f"b{bs} donate={int(donate)}: {type(e).__name__}: "
                       f"{str(e)[:90]}", flush=True)
                 continue
             mfu = 3 * FLOPS_FWD_IMG * bs / t / PEAK
-            print(f"b{bs} donate={int(donate)} remat=0          "
+            print(f"b{bs} donate={int(donate)}                  "
                   f"{t * 1e3:8.2f} {bs / t:8.1f} {mfu:9.3f}", flush=True)
 
 

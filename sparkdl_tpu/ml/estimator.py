@@ -55,7 +55,12 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         "'learning_rate': float, 'shuffle': bool, 'seed': int, "
         "'streaming': bool, 'mixed_precision': bool, "
         "'shuffle_buffer': int (windowed-shuffle pool depth in batches, "
-        "streaming path; default 4)}",
+        "streaming path; default 4), "
+        "'validation_data': (X, y) arrays evaluated at each epoch end, "
+        "'validation_split': float tail fraction held out (collected "
+        "path only), 'verbose': bool (per-step metrics JSONL to stdout), "
+        "'log_every': int, 'checkpoint_dir': str (Orbax mid-training "
+        "checkpoints + resume), 'checkpoint_every': int steps}",
         typeConverter=TypeConverters.identity)
 
     @keyword_only
@@ -194,6 +199,62 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
 
     # -- fitting -------------------------------------------------------------
 
+    def _fit_run(self, trainer, state, batches, fit_params,
+                 mf: ModelFunction):
+        """Shared train-loop driver for both fit paths: wires validation
+        evaluation (keras ``validation_data`` semantics), per-step metrics
+        JSONL (``verbose``/``log_every``, SURVEY.md §5.5), and Orbax
+        mid-training checkpoints + resume (``checkpoint_dir``/
+        ``checkpoint_every``, §5.4) into ``Trainer.fit``. Returns
+        ``(state, history)`` — history is keras-History-shaped:
+        {'epochs': [...], 'steps': [...]}.
+        """
+        epochs = int(fit_params.get("epochs", 1))
+        history: Dict[str, Any] = {"epochs": [], "steps": []}
+
+        val_batches = None
+        if fit_params.get("validation_data") is not None:
+            vx, vy = fit_params["validation_data"]
+            vx = np.asarray(vx)
+            vy = self._prepare_labels(np.asarray(vy), mf)
+            vbs = int(fit_params.get("batch_size", 32))
+            val_batches = [(vx[i:i + vbs], vy[i:i + vbs])
+                           for i in range(0, len(vx), vbs)]
+
+        logger = None
+        if fit_params.get("verbose"):
+            from sparkdl_tpu.train.metrics import MetricsLogger
+
+            logger = MetricsLogger(every=int(fit_params.get("log_every", 1)))
+
+        checkpoint = None
+        if fit_params.get("checkpoint_dir"):
+            from sparkdl_tpu.train.checkpoint import CheckpointManager
+
+            checkpoint = CheckpointManager(str(fit_params["checkpoint_dir"]))
+
+        def on_epoch(epoch: int, st) -> None:
+            record: Dict[str, Any] = {"epoch": epoch}
+            if val_batches is not None:
+                record.update(trainer.evaluate(st, val_batches))
+            history["epochs"].append(record)
+            if fit_params.get("verbose") and len(record) > 1:
+                import json as _json
+
+                print(_json.dumps(record, default=float), flush=True)
+
+        state = trainer.fit(
+            state, batches, epochs=epochs, metrics_logger=logger,
+            checkpoint=checkpoint,
+            checkpoint_every=int(fit_params.get("checkpoint_every", 0)),
+            on_epoch=on_epoch)
+        if checkpoint is not None:
+            checkpoint.wait_until_finished()
+            checkpoint.close()
+        if logger is not None:
+            history["steps"] = logger.history
+        return state, history
+
     def _fit_streaming(self, dataset) -> "KerasImageFileModel":
         """Streaming ``fit``: memory bounded by batch + a few partitions.
 
@@ -218,7 +279,6 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
 
         mf = self._model_function()
         fit_params = self.getKerasFitParams()
-        epochs = int(fit_params.get("epochs", 1))
         batch_size = int(fit_params.get("batch_size", 32))
         shuffle = bool(fit_params.get("shuffle", True))
         seed = int(fit_params.get("seed", 0))
@@ -234,9 +294,22 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
                 raise ValueError(
                     "multi-host fit requires a mesh (the data axis carries "
                     "the per-host shards)")
+            if multiple % num_proc != 0:
+                # data_axis_size comes from the user's MeshConfig; a
+                # model-parallel mesh with data < process_count would make
+                # the local share 0 (ZeroDivisionError downstream)
+                raise ValueError(
+                    f"multi-host fit needs the mesh data axis "
+                    f"({multiple}) to be a multiple of the process count "
+                    f"({num_proc})")
+            if (fit_params.get("validation_data") is not None
+                    or fit_params.get("validation_split")):
+                raise ValueError(
+                    "validation is not supported under multi-host fit "
+                    "(evaluation stages host-local arrays); validate the "
+                    "fitted model afterwards")
             # every host contributes an equal local slice of each global
-            # batch; the data axis is a multiple of process_count on any
-            # jax.distributed topology, so this divides exactly
+            # batch
             batch_size //= num_proc
             multiple //= num_proc
         loaded, target_size = self._loaded_frame(dataset)
@@ -253,16 +326,22 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
             shuffle_buffer=int(fit_params.get("shuffle_buffer", 4)),
             process_id=jax.process_index() if num_proc > 1 else None,
             num_processes=num_proc if num_proc > 1 else None)
+        if fit_params.get("validation_split"):
+            raise ValueError(
+                "validation_split needs the whole dataset in memory — use "
+                "streaming=False, or pass validation_data arrays instead")
         trainer, state = Trainer.from_model_function(
             mf, loss=self.getKerasLoss(), optimizer=self.getKerasOptimizer(),
             learning_rate=lr, mesh=mesh,
             compute_dtype=self._compute_dtype(fit_params))
-        state = trainer.fit(state, stream, epochs=epochs)
+        state, history = self._fit_run(trainer, state, stream, fit_params, mf)
         if stream.batches_last_epoch == 0:
             raise ValueError("No decodable training images")
-        return self._wrap_trained(mf, state)
+        return self._wrap_trained(mf, state, history)
 
-    def _wrap_trained(self, mf: ModelFunction, state) -> "KerasImageFileModel":
+    def _wrap_trained(self, mf: ModelFunction, state,
+                      history: Optional[Dict[str, Any]] = None
+                      ) -> "KerasImageFileModel":
         trained = ModelFunction(mf.apply_fn, jax.device_get(state.params),
                                 mf.input_spec, name=mf.name + "_trained",
                                 trainable_mask=mf.trainable_mask)
@@ -272,6 +351,9 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
             batchSize=self.getBatchSize(), mesh=self.getMesh(),
             imageLoader=self.getImageLoader())
         model._set_parent(self)
+        # keras-History analog: per-epoch validation metrics + per-step
+        # training metrics (when verbose logging was on)
+        model.history = history or {"epochs": [], "steps": []}
         return model
 
     def _fit_on_arrays(self, x: np.ndarray, y: np.ndarray
@@ -282,7 +364,6 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         mf = self._model_function()
         y = self._prepare_labels(y, mf)
         fit_params = self.getKerasFitParams()
-        epochs = int(fit_params.get("epochs", 1))
         batch_size = int(fit_params.get("batch_size", 32))
         shuffle = bool(fit_params.get("shuffle", True))
         seed = int(fit_params.get("seed", 0))
@@ -290,6 +371,21 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         mesh = self.resolveMesh()
         if mesh is not None:
             batch_size = pad_to_multiple(batch_size, data_axis_size(mesh))
+        split = float(fit_params.get("validation_split", 0.0) or 0.0)
+        if split:
+            # keras semantics: the validation slice is the TAIL of the
+            # data as provided, taken BEFORE shuffling
+            if not 0.0 < split < 1.0:
+                raise ValueError(
+                    f"validation_split must be in (0, 1), got {split}")
+            n_val = int(len(x) * split)
+            if n_val == 0 or n_val == len(x):
+                raise ValueError(
+                    f"validation_split={split} leaves an empty train or "
+                    f"validation set for {len(x)} rows")
+            fit_params = dict(fit_params,
+                              validation_data=(x[-n_val:], y[-n_val:]))
+            x, y = x[:-n_val], y[:-n_val]
         if shuffle:
             perm = np.random.default_rng(seed).permutation(len(x))
             x, y = x[perm], y[perm]
@@ -316,8 +412,9 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
             mf, loss=self.getKerasLoss(), optimizer=self.getKerasOptimizer(),
             learning_rate=lr, mesh=mesh,
             compute_dtype=self._compute_dtype(fit_params))
-        state = trainer.fit(state, batches, epochs=epochs)
-        return self._wrap_trained(mf, state)
+        state, history = self._fit_run(trainer, state, batches, fit_params,
+                                       mf)
+        return self._wrap_trained(mf, state, history)
 
     def _fit(self, dataset) -> "KerasImageFileModel":
         if bool(self.getKerasFitParams().get("streaming", True)):

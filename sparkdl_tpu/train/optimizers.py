@@ -32,7 +32,14 @@ _DEFAULT_LR = {"sgd": 0.01, "adam": 1e-3, "adamw": 1e-3, "rmsprop": 1e-3,
 def make_optimizer(name_or_tx: Union[str, optax.GradientTransformation],
                    learning_rate: float = None,
                    **kwargs) -> optax.GradientTransformation:
-    """Resolve a keras-style optimizer name (or pass through an optax tx)."""
+    """Resolve a keras-style optimizer name (or pass through an optax tx).
+
+    Named optimizers are built through ``optax.inject_hyperparams`` so the
+    learning rate lives in ``opt_state.hyperparams`` (a runtime value)
+    rather than baked into the update program — one compiled train step
+    then serves every learning rate (the Trainer's per-ModelFunction step
+    cache; an HPO sweep over lr compiles once instead of once per map).
+    """
     if not isinstance(name_or_tx, str):
         return name_or_tx
     name = name_or_tx.lower()
@@ -43,7 +50,9 @@ def make_optimizer(name_or_tx: Union[str, optax.GradientTransformation],
             f"Unsupported optimizer {name_or_tx!r}; supported: "
             f"{sorted(_OPTIMIZERS)}") from None
     lr = learning_rate if learning_rate is not None else _DEFAULT_LR[name]
-    return ctor(lr, **kwargs)
+    return optax.inject_hyperparams(
+        lambda learning_rate: ctor(learning_rate, **kwargs))(
+            learning_rate=lr)
 
 
 # -- losses ------------------------------------------------------------------

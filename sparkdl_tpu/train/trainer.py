@@ -76,6 +76,14 @@ class Trainer:
     # boundaries inside the module definition, which the Trainer runs
     # unchanged.
     compute_dtype: Any = None
+    # Optional shared compiled-step cache (from_model_function wires it to
+    # the ModelFunction): repeated fits of the same model — HPO maps,
+    # repeated estimator.fit — reuse ONE jitted step instead of paying the
+    # ~15 s tunnel compile each time. Safe because the step closes over no
+    # fit-specific values: params/opt_state arrive via TrainState and the
+    # learning rate is an opt_state hyperparam (make_optimizer injects it).
+    step_cache: Any = None
+    step_cache_key: Any = None
 
     # -- constructors --------------------------------------------------------
 
@@ -143,9 +151,27 @@ class Trainer:
             labels = jax.tree.map(lambda t: "train" if t else "freeze", mask)
             tx = optax.multi_transform(
                 {"train": tx, "freeze": optax.set_to_zero()}, labels)
+        cache = cache_key = None
+        if isinstance(loss, str) and isinstance(optimizer, str):
+            # lr is NOT part of the key: it's an injected opt_state
+            # hyperparam, so one compiled step serves every lr. EVERY
+            # other Trainer option (compute_accuracy, compute_dtype, ...)
+            # changes the compiled program, so all kwargs key the cache —
+            # any unhashable option value disables caching rather than
+            # risking a stale step.
+            try:
+                cache_key = (loss, optimizer, from_logits, mesh,
+                             tuple(sorted(
+                                 (k, str(v)) for k, v in kwargs.items())))
+                hash(cache_key)
+            except TypeError:
+                cache_key = None
+            if cache_key is not None:
+                cache = mf.__dict__.setdefault("_train_step_cache", {})
         trainer = cls(apply_fn=apply_fn, loss=make_loss(loss, from_logits=from_logits),
                       optimizer=tx, mesh=mesh, has_model_state=False,
-                      accuracy_from_logits=from_logits, **kwargs)
+                      accuracy_from_logits=from_logits,
+                      step_cache=cache, step_cache_key=cache_key, **kwargs)
         state = trainer.init_state(mf.variables, {})
         return trainer, state
 
@@ -169,10 +195,18 @@ class Trainer:
     def make_train_step(self, donate: bool = True) -> Callable:
         """Compiled ``(state, x, y) -> (state, metrics)``.
 
+        With a shared ``step_cache`` (from_model_function), the jitted
+        step is built once per (loss, optimizer, mesh, dtype, donate) and
+        reused by every subsequent fit of the same ModelFunction.
+
         One XLA program: forward, loss, backward, (implicit all-reduce),
         optimizer update, model-state update. With a mesh, x/y shard over
         ``data`` and state is replicated; XLA inserts the collectives.
         """
+        if self.step_cache is not None:
+            cached = self.step_cache.get((self.step_cache_key, donate))
+            if cached is not None:
+                return cached
         loss_fn = self.loss
         apply_fn = self.apply_fn
         optimizer = self.optimizer
@@ -242,12 +276,17 @@ class Trainer:
 
         kwargs: Dict[str, Any] = {"donate_argnums": (0,)} if donate else {}
         if self.mesh is None:
-            return jax.jit(step_fn, **kwargs)
-        data_sh = batch_sharding(self.mesh)
-        # state sharding None = keep as placed (replicated by fit/device_put);
-        # batch sharded over data → XLA all-reduces grads across the axis.
-        return jax.jit(step_fn, in_shardings=(None, data_sh, data_sh),
-                       **kwargs)
+            jitted = jax.jit(step_fn, **kwargs)
+        else:
+            data_sh = batch_sharding(self.mesh)
+            # state sharding None = keep as placed (replicated by
+            # fit/device_put); batch sharded over data → XLA all-reduces
+            # grads across the axis.
+            jitted = jax.jit(step_fn, in_shardings=(None, data_sh, data_sh),
+                             **kwargs)
+        if self.step_cache is not None:
+            self.step_cache[(self.step_cache_key, donate)] = jitted
+        return jitted
 
     def make_eval_step(self) -> Callable:
         apply_fn = self.apply_fn
@@ -262,6 +301,68 @@ class Trainer:
         return jax.jit(eval_fn, in_shardings=(None, data_sh),
                        out_shardings=data_sh)
 
+    def make_eval_metrics_step(self) -> Callable:
+        """Compiled ``(state, x, y) -> {loss, accuracy}`` (no grads).
+
+        Deliberately jitted WITHOUT batch in_shardings even under a mesh:
+        validation sets are small and arbitrarily sized, and a
+        data-sharded eval step would reject any batch not divisible by
+        the data axis. GSPMD propagates shardings from the (replicated)
+        state; exact metrics beat parallel evaluation here.
+        """
+        if self.step_cache is not None:
+            cached = self.step_cache.get((self.step_cache_key, "eval"))
+            if cached is not None:
+                return cached
+        apply_fn = self.apply_fn
+        loss_fn = self.loss
+        want_acc = self.compute_accuracy
+        acc_from_logits = self.accuracy_from_logits
+
+        def eval_fn(state: TrainState, x, y):
+            vs = {"params": state.params, **state.model_state}
+            out = apply_fn(vs, x, False, None)
+            metrics = {"loss": loss_fn(out, y)}
+            if want_acc:
+                metrics["accuracy"] = accuracy_metric(
+                    out, y, from_logits=acc_from_logits)
+            return metrics
+
+        jitted = jax.jit(eval_fn)
+        if self.step_cache is not None:
+            self.step_cache[(self.step_cache_key, "eval")] = jitted
+        return jitted
+
+    def evaluate(self, state: TrainState,
+                 batches: Iterable[Tuple[np.ndarray, np.ndarray]]
+                 ) -> Dict[str, float]:
+        """Mean loss/accuracy over a batch stream (keras ``evaluate``).
+
+        Single-controller only: each process evaluates with its own
+        host-local arrays. Multi-host fits must not call this (the
+        estimator rejects validation under multi-host up front).
+        """
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "Trainer.evaluate stages host-local arrays and cannot run "
+                "under a multi-host process group")
+        eval_step = self.make_eval_metrics_step()
+        totals: Dict[str, float] = {}
+        n = 0
+        for x, y in batches:
+            xd = jnp.asarray(np.asarray(x))
+            if xd.dtype == jnp.uint8:  # same contract as stage_batch
+                xd = xd.astype(jnp.float32)
+            m = jax.device_get(eval_step(state, xd,
+                                         jnp.asarray(np.asarray(y))))
+            k = len(x)
+            n += k
+            for key, value in m.items():
+                totals[key] = totals.get(key, 0.0) + float(value) * k
+        if n == 0:
+            return {}
+        return {f"val_{k}": v / n for k, v in totals.items()}
+
     # -- the loop ------------------------------------------------------------
 
     def fit(self, state: TrainState,
@@ -271,7 +372,9 @@ class Trainer:
             checkpoint: Optional[CheckpointManager] = None,
             checkpoint_every: int = 0,
             resume: bool = True,
-            on_step: Optional[Callable[[int], None]] = None) -> TrainState:
+            on_step: Optional[Callable[[int], None]] = None,
+            on_epoch: Optional[Callable[[int, TrainState], None]] = None
+            ) -> TrainState:
         """Run the train loop; resume from the latest checkpoint if present.
 
         ``batches``: a reiterable of ``(x, y)`` numpy pairs (all the same
@@ -279,6 +382,8 @@ class Trainer:
         compiled program). ``on_step(step)`` is the fault-injection hook
         (SURVEY.md §5.3): raising from it aborts the loop exactly as a
         worker loss would, and TPURunner restarts from the checkpoint.
+        ``on_epoch(epoch_index, state)`` fires after each epoch (the
+        estimator's validation-evaluation hook).
         """
         if checkpoint is not None and resume:
             latest = checkpoint.latest_step()
@@ -336,6 +441,8 @@ class Trainer:
                     checkpoint.save(step, jax.device_get(state))
                 if on_step is not None:
                     on_step(step)
+            if on_epoch is not None:
+                on_epoch(_epoch, state)
         if checkpoint is not None:
             checkpoint.save(int(state.step), jax.device_get(state),
                             synchronous=True)

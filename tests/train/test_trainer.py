@@ -277,3 +277,42 @@ def test_mixed_precision_batch_stats_stay_f32(rng):
     # the moving averages must actually MOVE: bf16 stats would stall on
     # small momentum increments (the update stays f32 by design)
     assert any(not np.allclose(a, b) for a, b in zip(init_stats, new_stats))
+
+
+def test_step_cache_shared_across_fits_and_lrs():
+    """from_model_function fits share ONE compiled step per
+    (loss, opt, mesh, dtype) — and the injected-lr design means different
+    learning rates reuse it while still applying their own lr."""
+    import numpy as np
+
+    from sparkdl_tpu.core.model_function import ModelFunction, TensorSpec
+    from sparkdl_tpu.train import Trainer
+
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    module = nn.Dense(1)
+    variables = module.init(jax.random.PRNGKey(0), jnp.zeros((1, 4)))
+    mf = ModelFunction(lambda vs, x: module.apply(vs, x),
+                       variables, TensorSpec((None, 4), "float32"),
+                       name="lin")
+    x = np.ones((8, 4), np.float32)
+    y = np.zeros((8, 1), np.float32)
+
+    def fitted_params(lr):
+        trainer, state = Trainer.from_model_function(
+            mf, loss="mse", optimizer="sgd", learning_rate=lr)
+        state = trainer.fit(state, [(x, y)], epochs=1)
+        return jax.device_get(state.params)
+
+    p_small = fitted_params(1e-4)
+    cache = mf._train_step_cache
+    assert len(cache) == 1
+    p_large = fitted_params(0.5)
+    assert len(cache) == 1  # second fit reused the compiled step...
+    small_step = np.abs(variables["params"]["kernel"]
+                        - p_small["params"]["kernel"]).max()
+    large_step = np.abs(variables["params"]["kernel"]
+                        - p_large["params"]["kernel"]).max()
+    assert large_step > 100 * small_step  # ...but applied ITS lr
