@@ -529,9 +529,77 @@ class DataFrame:
         return DataFrame.fromArrow(
             table, numPartitions=self.numPartitions + other.numPartitions)
 
+    def orderBy(self, *cols: str, ascending: Union[bool, Sequence[bool]] = True
+                ) -> "DataFrame":
+        """Global sort (materializing, like Spark's orderBy shuffle)."""
+        if not cols:
+            raise ValueError("orderBy needs at least one column")
+        if isinstance(ascending, bool):
+            ascending = [ascending] * len(cols)
+        if len(ascending) != len(cols):
+            raise ValueError("ascending must match the number of columns")
+        for c in cols:
+            if c not in self.columns:
+                raise KeyError(f"No such column: {c!r}")
+        keys = [(c, "ascending" if a else "descending")
+                for c, a in zip(cols, ascending)]
+        return DataFrame.fromArrow(self.toArrow().sort_by(keys),
+                                   numPartitions=self.numPartitions)
+
+    def groupBy(self, *cols: str) -> "GroupedData":
+        """Grouped aggregation (Arrow-native group_by under the hood)."""
+        for c in cols:
+            if c not in self.columns:
+                raise KeyError(f"No such column: {c!r}")
+        return GroupedData(self, list(cols))
+
     def cache(self) -> "DataFrame":
         self._materialize()
         return self
+
+
+class GroupedData:
+    """``df.groupBy(cols)`` result: Spark-shaped aggregations lowered onto
+    pyarrow's native ``Table.group_by`` (columnar, no Python row loop)."""
+
+    _AGGS = {"sum", "mean", "avg", "min", "max", "count"}
+
+    def __init__(self, df: "DataFrame", cols: List[str]) -> None:
+        self._df = df
+        self._cols = cols
+
+    def count(self) -> "DataFrame":
+        grouped = self._df.toArrow().group_by(self._cols).aggregate(
+            [([], "count_all")])
+        return DataFrame.fromArrow(
+            grouped.rename_columns(self._cols + ["count"]))
+
+    def agg(self, exprs: Dict[str, str]) -> "DataFrame":
+        """``{"column": "sum"|"mean"|"avg"|"min"|"max"|"count"}`` →
+        one row per group with ``<agg>(<column>)`` result columns
+        (Spark's dict-form ``agg``)."""
+        aggs = []
+        names = []
+        for col, fn in exprs.items():
+            fn = fn.lower()
+            if fn not in self._AGGS:
+                raise ValueError(
+                    f"Unsupported aggregate {fn!r}; supported: "
+                    f"{sorted(self._AGGS)}")
+            if col not in self._df.columns:
+                raise KeyError(f"No such column: {col!r}")
+            arrow_fn = {"avg": "mean"}.get(fn, fn)
+            aggs.append((col, arrow_fn))
+            names.append(f"{fn}({col})")
+        grouped = self._df.toArrow().group_by(self._cols).aggregate(aggs)
+        return DataFrame.fromArrow(
+            grouped.rename_columns(self._cols + names))
+
+    def mean(self, *cols: str) -> "DataFrame":
+        return self.agg({c: "mean" for c in cols})
+
+    def sum(self, *cols: str) -> "DataFrame":
+        return self.agg({c: "sum" for c in cols})
 
 
 # ---------------------------------------------------------------------------

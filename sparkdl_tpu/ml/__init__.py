@@ -15,6 +15,10 @@ from sparkdl_tpu.ml.base import (
     PipelineModel,
     Transformer,
 )
+from sparkdl_tpu.ml.classification import (
+    LogisticRegression,
+    LogisticRegressionModel,
+)
 from sparkdl_tpu.ml.estimator import KerasImageFileEstimator, KerasImageFileModel
 from sparkdl_tpu.ml.image_transformer import TPUImageTransformer
 from sparkdl_tpu.ml.keras_image import KerasImageFileTransformer
@@ -36,6 +40,8 @@ __all__ = [
     "KerasImageFileModel",
     "KerasImageFileTransformer",
     "KerasTransformer",
+    "LogisticRegression",
+    "LogisticRegressionModel",
     "Model",
     "Pipeline",
     "load",

@@ -1,0 +1,311 @@
+"""Downstream classical learners: LogisticRegression (+ model).
+
+Parity: the reference's flagship workflow is
+``Pipeline([DeepImageFeaturizer, LogisticRegression])`` — the featurizer
+emits a vector column and **Spark ML's** LogisticRegression consumes it
+(upstream README example; SURVEY.md §0). The rebuild has no Spark ML to
+lean on, so the consumer ships in-framework with Spark's param surface
+(``featuresCol/labelCol/predictionCol/probabilityCol, maxIter, regParam,
+tol, fitIntercept``) and TPU-native training: one jitted
+``lax.while_loop`` of L-BFGS (optax) over the full feature matrix —
+multinomial softmax with L2 regularization, converged on gradient norm.
+
+Scale note: features for classical learners are small (thousands of rows
+x 2048 dims); full-batch on-device optimization IS the idiomatic TPU
+form — per-row streaming would be dispatch-bound.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from sparkdl_tpu.ml.base import Estimator, Model
+from sparkdl_tpu.param.base import Param, keyword_only
+from sparkdl_tpu.param.converters import (
+    SparkDLTypeConverters,
+    TypeConverters,
+)
+from sparkdl_tpu.param.shared_params import HasLabelCol
+
+
+class _HasClassifierCols(HasLabelCol):
+    featuresCol = Param("_HasClassifierCols", "featuresCol",
+                        "input column of fixed-length float vectors",
+                        typeConverter=SparkDLTypeConverters.toColumnName)
+    predictionCol = Param("_HasClassifierCols", "predictionCol",
+                          "output column: predicted class index (float, "
+                          "Spark ML convention)",
+                          typeConverter=SparkDLTypeConverters.toColumnName)
+    probabilityCol = Param("_HasClassifierCols", "probabilityCol",
+                           "output column: class probability vector",
+                           typeConverter=SparkDLTypeConverters.toColumnName)
+
+    def setFeaturesCol(self, value): return self._set(featuresCol=value)
+
+    def getFeaturesCol(self): return self.getOrDefault(self.featuresCol)
+
+    def setPredictionCol(self, value): return self._set(predictionCol=value)
+
+    def getPredictionCol(self): return self.getOrDefault(self.predictionCol)
+
+    def setProbabilityCol(self, value): return self._set(probabilityCol=value)
+
+    def getProbabilityCol(self): return self.getOrDefault(self.probabilityCol)
+
+
+class LogisticRegression(Estimator, _HasClassifierCols):
+    """Multinomial (softmax) logistic regression on a vector column.
+
+    Spark-ML-parity params; binary problems are the k=2 case of the same
+    multinomial form (probabilities match Spark's ``family='multinomial'``
+    up to its coefficient centering).
+    """
+
+    maxIter = Param("LogisticRegression", "maxIter",
+                    "maximum L-BFGS iterations",
+                    typeConverter=TypeConverters.toInt)
+    regParam = Param("LogisticRegression", "regParam",
+                     "L2 regularization strength (0 disables)",
+                     typeConverter=TypeConverters.toFloat)
+    tol = Param("LogisticRegression", "tol",
+                "convergence tolerance on the gradient norm",
+                typeConverter=TypeConverters.toFloat)
+    fitIntercept = Param("LogisticRegression", "fitIntercept",
+                         "whether to fit an intercept term",
+                         typeConverter=TypeConverters.toBoolean)
+
+    @keyword_only
+    def __init__(self, *, featuresCol: str = "features",
+                 labelCol: str = "label",
+                 predictionCol: str = "prediction",
+                 probabilityCol: str = "probability",
+                 maxIter: int = 100, regParam: float = 0.0,
+                 tol: float = 1e-6, fitIntercept: bool = True) -> None:
+        super().__init__()
+        self._setDefault(featuresCol="features", labelCol="label",
+                         predictionCol="prediction",
+                         probabilityCol="probability", maxIter=100,
+                         regParam=0.0, tol=1e-6, fitIntercept=True)
+        self.setParams(**self._input_kwargs)
+
+    @keyword_only
+    def setParams(self, *, featuresCol: str = "features",
+                  labelCol: str = "label",
+                  predictionCol: str = "prediction",
+                  probabilityCol: str = "probability",
+                  maxIter: int = 100, regParam: float = 0.0,
+                  tol: float = 1e-6,
+                  fitIntercept: bool = True) -> "LogisticRegression":
+        self._set(**self._input_kwargs)
+        return self
+
+    def setMaxIter(self, value): return self._set(maxIter=value)
+
+    def getMaxIter(self): return self.getOrDefault(self.maxIter)
+
+    def setRegParam(self, value): return self._set(regParam=value)
+
+    def getRegParam(self): return self.getOrDefault(self.regParam)
+
+    def setTol(self, value): return self._set(tol=value)
+
+    def getTol(self): return self.getOrDefault(self.tol)
+
+    def setFitIntercept(self, value): return self._set(fitIntercept=value)
+
+    def getFitIntercept(self): return self.getOrDefault(self.fitIntercept)
+
+    def _collect_xy(self, dataset):
+        rows = dataset.select(self.getFeaturesCol(),
+                              self.getLabelCol()).collect()
+        feats, labels = [], []
+        for r in rows:
+            f = r[self.getFeaturesCol()]
+            if f is None:
+                continue
+            feats.append(np.asarray(f, np.float32))
+            labels.append(r[self.getLabelCol()])
+        if not feats:
+            raise ValueError("no non-null feature rows to fit on")
+        x = np.stack(feats)
+        y = np.asarray(labels)
+        if y.dtype.kind not in "iuf":
+            raise ValueError(
+                f"labelCol {self.getLabelCol()!r} must hold numeric class "
+                f"indices, got dtype {y.dtype}")
+        y = y.astype(np.int32)
+        if y.min() < 0:
+            raise ValueError("labels must be non-negative class indices")
+        return x, y, int(y.max()) + 1
+
+    # -- persistence (unfitted: params-only metadata) ------------------------
+
+    def save(self, path: str) -> None:
+        import os
+
+        from sparkdl_tpu.ml import persistence as P
+
+        os.makedirs(path, exist_ok=True)
+        P.write_metadata(path, self, P.jsonable_params(self), {})
+
+    @classmethod
+    def _load_from(cls, path: str, meta):
+        return cls(**meta["params"])
+
+    def _fit(self, dataset) -> "LogisticRegressionModel":
+        x, y, n_classes = self._collect_xy(dataset)
+        if n_classes < 2:
+            n_classes = 2
+        w, b, iters = _fit_softmax(
+            x, y, n_classes, max_iter=self.getMaxIter(),
+            reg=self.getRegParam(), tol=self.getTol(),
+            fit_intercept=self.getFitIntercept())
+        model = LogisticRegressionModel(
+            featuresCol=self.getFeaturesCol(), labelCol=self.getLabelCol(),
+            predictionCol=self.getPredictionCol(),
+            probabilityCol=self.getProbabilityCol())
+        model._set_weights(np.asarray(w), np.asarray(b))
+        model.numIterations = int(iters)
+        model._set_parent(self)
+        return model
+
+
+def _fit_softmax(x: np.ndarray, y: np.ndarray, n_classes: int,
+                 max_iter: int, reg: float, tol: float,
+                 fit_intercept: bool):
+    """Jitted L-BFGS on mean softmax-CE + (reg/2)·||W||²; whole opt loop
+    is ONE XLA program (lax.while_loop over optax.lbfgs updates)."""
+    xd = jnp.asarray(x)
+    yd = jnp.asarray(y)
+    d = x.shape[1]
+
+    def loss_fn(params):
+        logits = xd @ params["w"]
+        if fit_intercept:
+            logits = logits + params["b"]
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            logits, yd).mean()
+        return ce + 0.5 * reg * jnp.sum(params["w"] ** 2)
+
+    opt = optax.lbfgs()
+    params0 = {"w": jnp.zeros((d, n_classes), jnp.float32),
+               "b": jnp.zeros((n_classes,), jnp.float32)}
+
+    @jax.jit
+    def run(params):
+        value_and_grad = optax.value_and_grad_from_state(loss_fn)
+        state0 = opt.init(params)
+
+        def cond(carry):
+            params, state, g, i = carry
+            gnorm = optax.global_norm(g)
+            return (i < max_iter) & (gnorm > tol)
+
+        def body(carry):
+            params, state, _, i = carry
+            value, grad = value_and_grad(params, state=state)
+            updates, state = opt.update(
+                grad, state, params, value=value, grad=grad,
+                value_fn=loss_fn)
+            params = optax.apply_updates(params, updates)
+            return params, state, grad, i + 1
+
+        g0 = jax.grad(loss_fn)(params)
+        params, state, g, iters = jax.lax.while_loop(
+            cond, body, (params, state0, g0, jnp.zeros((), jnp.int32)))
+        return params, iters
+
+    params, iters = run(params0)
+    return (jax.device_get(params["w"]), jax.device_get(params["b"]),
+            jax.device_get(iters))
+
+
+class LogisticRegressionModel(Model, _HasClassifierCols):
+    """Fitted model: adds prediction (+ probability) columns."""
+
+    @keyword_only
+    def __init__(self, *, featuresCol: str = "features",
+                 labelCol: str = "label",
+                 predictionCol: str = "prediction",
+                 probabilityCol: str = "probability") -> None:
+        super().__init__()
+        self._setDefault(featuresCol="features", labelCol="label",
+                         predictionCol="prediction",
+                         probabilityCol="probability")
+        self._set(**self._input_kwargs)
+        self.numIterations: Optional[int] = None
+
+    def _set_weights(self, w: np.ndarray, b: np.ndarray) -> None:
+        self._w = np.asarray(w, np.float32)
+        self._b = np.asarray(b, np.float32)
+
+    @property
+    def coefficients(self) -> np.ndarray:
+        return self._w
+
+    @property
+    def intercept(self) -> np.ndarray:
+        return self._b
+
+    @property
+    def numClasses(self) -> int:
+        return int(self._w.shape[1])
+
+    def _transform(self, dataset):
+        import pyarrow as pa
+
+        w, b = self._w, self._b
+        feat_col = self.getFeaturesCol()
+        prob_col = self.getProbabilityCol()
+
+        def predict_batch(batch: "pa.RecordBatch") -> "pa.Array":
+            col = batch.column(batch.schema.get_field_index(feat_col))
+            rows = col.to_pylist()
+            out = []
+            probs_by_row: Dict[int, np.ndarray] = {}
+            valid = [i for i, r in enumerate(rows) if r is not None]
+            if valid:
+                x = np.asarray([rows[i] for i in valid], np.float32)
+                logits = x @ w + b
+                logits -= logits.max(axis=1, keepdims=True)
+                e = np.exp(logits)
+                probs = e / e.sum(axis=1, keepdims=True)
+                probs_by_row = dict(zip(valid, probs))
+            for i in range(len(rows)):
+                out.append(probs_by_row[i].tolist() if i in probs_by_row
+                           else None)
+            return pa.array(out, type=pa.list_(pa.float32()))
+
+        with_probs = dataset.withColumnBatch(
+            prob_col, predict_batch,
+            outputType=pa.list_(pa.float32()))
+        return with_probs.withColumn(
+            self.getPredictionCol(),
+            lambda p: None if p is None else float(int(np.argmax(p))),
+            inputCols=[prob_col])
+
+    # -- persistence ---------------------------------------------------------
+
+    def save(self, path: str) -> None:
+        import os
+
+        from sparkdl_tpu.ml import persistence as P
+
+        os.makedirs(path, exist_ok=True)
+        params = P.jsonable_params(self)
+        np.savez(os.path.join(path, "weights.npz"), w=self._w, b=self._b)
+        P.write_metadata(path, self, params, {"weights": "weights.npz"})
+
+    @classmethod
+    def _load_from(cls, path: str, meta):
+        import os
+
+        inst = cls(**meta["params"])
+        data = np.load(os.path.join(path, meta["artifacts"]["weights"]))
+        inst._set_weights(data["w"], data["b"])
+        return inst

@@ -372,6 +372,10 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         if mesh is not None:
             batch_size = pad_to_multiple(batch_size, data_axis_size(mesh))
         split = float(fit_params.get("validation_split", 0.0) or 0.0)
+        if split and fit_params.get("validation_data") is not None:
+            # keras precedence: explicit validation_data wins and the
+            # split is ignored (no rows held out)
+            split = 0.0
         if split:
             # keras semantics: the validation slice is the TAIL of the
             # data as provided, taken BEFORE shuffling

@@ -42,6 +42,8 @@ _LOADABLE = {
     "sparkdl_tpu.ml.tensor_transformer.TPUTransformer",
     "sparkdl_tpu.ml.keras_image.KerasImageFileTransformer",
     "sparkdl_tpu.ml.keras_tensor.KerasTransformer",
+    "sparkdl_tpu.ml.classification.LogisticRegression",
+    "sparkdl_tpu.ml.classification.LogisticRegressionModel",
     "sparkdl_tpu.ml.estimator.KerasImageFileEstimator",
     "sparkdl_tpu.ml.estimator.KerasImageFileModel",
     "sparkdl_tpu.ml.base.Pipeline",

@@ -45,6 +45,11 @@ _ACTIVATIONS: Dict[str, Callable] = {
     "swish": jax.nn.silu,
     "exponential": jnp.exp,
     "hard_sigmoid": jax.nn.hard_sigmoid,
+    # keras hard_silu/hard_swish = x * relu6(x+3)/6 — jax.nn.hard_silu's
+    # exact definition (MobileNetV3's activation)
+    "hard_silu": jax.nn.hard_silu,
+    "hard_swish": jax.nn.hard_silu,
+    "mish": lambda x: x * jnp.tanh(jax.nn.softplus(x)),
     "leaky_relu": lambda x: jax.nn.leaky_relu(x, negative_slope=0.2),
 }
 
@@ -207,6 +212,49 @@ def _convert_layer(layer, input_rank=None) -> Callable[[List[jnp.ndarray]], Call
             return y
 
         return bn
+
+    if cls == "Normalization":
+        # keras preprocessing Normalization (EfficientNet/ConvNeXt stems):
+        # (x - mean) / max(sqrt(var), eps), or the inverse map. mean/var
+        # are fixed statistics (given at init or adapt()ed) — bake them at
+        # ingestion; they're already reshaped broadcast-ready per axis.
+        import keras as _keras
+
+        mean = jnp.asarray(np.asarray(layer.mean), jnp.float32)
+        std = jnp.maximum(
+            jnp.sqrt(jnp.asarray(np.asarray(layer.variance), jnp.float32)),
+            _keras.config.epsilon())
+        if bool(getattr(layer, "invert", False)):
+            return lambda w, x: mean + x * std
+        return lambda w, x: (x - mean) / std
+
+    if cls == "LayerNormalization":
+        axis = layer.axis
+        axes = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+        eps = float(layer.epsilon)
+        scale, center = layer.scale, layer.center
+        if getattr(layer, "rms_scaling", False):
+            raise ValueError(
+                f"Unsupported LayerNormalization rms_scaling on layer "
+                f"{layer.name!r}")
+
+        def layernorm(w, x):
+            mean = jnp.mean(x, axis=axes, keepdims=True)
+            var = jnp.var(x, axis=axes, keepdims=True)
+            y = (x - mean) * jax.lax.rsqrt(var + eps)
+            i = 0
+            if scale:
+                y = y * w[i]
+                i += 1
+            if center:
+                y = y + w[i]
+            return y
+
+        return layernorm
+
+    if cls == "LayerScale":
+        # keras.applications.convnext's per-channel learned scale
+        return lambda w, x: x * w[0]
 
     if cls == "Activation":
         act = _activation_fn(layer.activation)

@@ -314,6 +314,9 @@ class Trainer:
             cached = self.step_cache.get((self.step_cache_key, "eval"))
             if cached is not None:
                 return cached
+        own = self.__dict__.get("_eval_step")
+        if own is not None:
+            return own
         apply_fn = self.apply_fn
         loss_fn = self.loss
         want_acc = self.compute_accuracy
@@ -331,6 +334,10 @@ class Trainer:
         jitted = jax.jit(eval_fn)
         if self.step_cache is not None:
             self.step_cache[(self.step_cache_key, "eval")] = jitted
+        else:
+            # no shared cache (custom loss/optimizer objects): memoize on
+            # this Trainer so per-epoch evaluate() doesn't recompile
+            self.__dict__["_eval_step"] = jitted
         return jitted
 
     def evaluate(self, state: TrainState,

@@ -248,3 +248,44 @@ def test_stream_partitions_order(rng):
     permuted2 = [p.column(0).to_pylist()
                  for p in df2.streamPartitions(order=order)]
     assert permuted2 == permuted
+
+
+def test_order_by():
+    df = DataFrame.fromRows(
+        [{"a": 3, "b": "x"}, {"a": 1, "b": "y"}, {"a": 2, "b": "z"}],
+        numPartitions=2)
+    assert [r["a"] for r in df.orderBy("a").collect()] == [1, 2, 3]
+    assert [r["a"] for r in df.orderBy("a", ascending=False).collect()] == \
+        [3, 2, 1]
+    with pytest.raises(KeyError):
+        df.orderBy("nope")
+
+
+def test_order_by_multi_key():
+    rows = [{"g": "b", "v": 1}, {"g": "a", "v": 2}, {"g": "a", "v": 1}]
+    df = DataFrame.fromRows(rows)
+    got = df.orderBy("g", "v", ascending=[True, False]).collect()
+    assert [(r["g"], r["v"]) for r in got] == [("a", 2), ("a", 1), ("b", 1)]
+
+
+def test_group_by_count_and_agg():
+    rows = [{"g": "a", "v": 1.0}, {"g": "a", "v": 3.0}, {"g": "b", "v": 5.0}]
+    df = DataFrame.fromRows(rows, numPartitions=2)
+    counts = {r["g"]: r["count"] for r in df.groupBy("g").count().collect()}
+    assert counts == {"a": 2, "b": 1}
+    sums = {r["g"]: r["sum(v)"]
+            for r in df.groupBy("g").agg({"v": "sum"}).collect()}
+    assert sums == {"a": 4.0, "b": 5.0}
+    out = df.groupBy("g").agg({"v": "mean"}).orderBy("g").collect()
+    assert out[0]["mean(v)"] == 2.0 and out[1]["mean(v)"] == 5.0
+    with pytest.raises(ValueError, match="Unsupported aggregate"):
+        df.groupBy("g").agg({"v": "median"})
+
+
+def test_group_by_convenience_mean_sum():
+    rows = [{"g": 1, "v": 2.0}, {"g": 1, "v": 4.0}, {"g": 2, "v": 10.0}]
+    df = DataFrame.fromRows(rows)
+    m = {r["g"]: r["mean(v)"] for r in df.groupBy("g").mean("v").collect()}
+    assert m == {1: 3.0, 2: 10.0}
+    s = {r["g"]: r["sum(v)"] for r in df.groupBy("g").sum("v").collect()}
+    assert s == {1: 6.0, 2: 10.0}

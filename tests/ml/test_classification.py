@@ -1,0 +1,151 @@
+"""LogisticRegression: the downstream learner of the reference's flagship
+``Pipeline([DeepImageFeaturizer, LogisticRegression])`` workflow
+(upstream README example; SURVEY.md §0).
+
+Oracle criteria: convergence to the data-generating decision rule on
+separable data, multinomial probability sanity, and the full
+featurize->classify pipeline end-to-end — plus persistence round-trips.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from sparkdl_tpu.engine.dataframe import DataFrame
+from sparkdl_tpu.image import imageIO
+from sparkdl_tpu.ml import (
+    DeepImageFeaturizer,
+    LogisticRegression,
+    LogisticRegressionModel,
+    Pipeline,
+    load,
+)
+
+
+@pytest.fixture
+def blobs_df(rng):
+    """Three well-separated gaussian blobs in 5-D."""
+    centers = np.array([[4, 0, 0, 0, 0], [0, 4, 0, 0, 0], [0, 0, 4, 0, 0]],
+                       np.float32)
+    xs, ys = [], []
+    for c in range(3):
+        xs.append(rng.normal(size=(40, 5)).astype(np.float32) * 0.4
+                  + centers[c])
+        ys.extend([c] * 40)
+    x = np.concatenate(xs)
+    rows = [{"features": x[i].tolist(), "label": int(ys[i])}
+            for i in range(len(x))]
+    return DataFrame.fromRows(rows, numPartitions=3), x, np.asarray(ys)
+
+
+def test_fit_separable_converges(blobs_df):
+    df, x, y = blobs_df
+    lr = LogisticRegression(maxIter=200, regParam=0.0)
+    model = lr.fit(df)
+    assert model.numClasses == 3
+    assert model.numIterations is not None and model.numIterations > 0
+    out = model.transform(df).collect()
+    preds = np.array([r["prediction"] for r in out])
+    assert (preds == y).mean() >= 0.99
+    probs = np.array([r["probability"] for r in out])
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-5)
+    assert (probs.max(axis=1) > 0.8).mean() > 0.9  # confident on blobs
+
+
+def test_binary_and_regularization(blobs_df, rng):
+    x = rng.normal(size=(80, 4)).astype(np.float32)
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(int)
+    rows = [{"features": x[i].tolist(), "label": int(y[i])}
+            for i in range(80)]
+    df = DataFrame.fromRows(rows, numPartitions=2)
+    model = LogisticRegression(maxIter=300).fit(df)
+    preds = np.array([r["prediction"]
+                      for r in model.transform(df).collect()])
+    assert (preds == y).mean() >= 0.95
+    # strong L2 shrinks coefficients
+    small = LogisticRegression(maxIter=300, regParam=10.0).fit(df)
+    assert (np.abs(small.coefficients).max()
+            < np.abs(model.coefficients).max() / 2)
+
+
+def test_null_features_pass_through(blobs_df):
+    df, _, _ = blobs_df
+    with_null = DataFrame.fromRows(
+        [{"features": None, "label": 0}] + df.collect(), numPartitions=2)
+    model = LogisticRegression(maxIter=50).fit(with_null)
+    out = model.transform(with_null).collect()
+    assert out[0]["prediction"] is None and out[0]["probability"] is None
+    assert out[1]["prediction"] is not None
+
+
+def test_featurizer_lr_pipeline_end_to_end(rng, tmp_path):
+    """The reference's flagship workflow on this framework: image structs
+    -> DeepImageFeaturizer(TestNet) -> LogisticRegression, fitted as ONE
+    Pipeline and reloaded from disk."""
+    rows = []
+    for i in range(24):
+        label = i % 2
+        arr = rng.integers(0, 40, size=(32, 32, 3), dtype=np.uint8)
+        arr[..., label] += 150
+        rows.append({"image": imageIO.imageArrayToStruct(arr),
+                     "label": label})
+    df = DataFrame.fromRows(
+        rows, schema=pa.schema([pa.field("image", imageIO.imageSchema),
+                                pa.field("label", pa.int64())]),
+        numPartitions=2)
+    pipe = Pipeline(stages=[
+        DeepImageFeaturizer(inputCol="image", outputCol="features",
+                            modelName="TestNet", batchSize=8),
+        LogisticRegression(maxIter=200),
+    ])
+    fitted = pipe.fit(df)
+    out = fitted.transform(df).collect()
+    preds = np.array([r["prediction"] for r in out])
+    labels = np.array([r["label"] for r in out])
+    assert (preds == labels).mean() >= 0.9
+
+    fitted.save(str(tmp_path / "pipe"))
+    reloaded = load(str(tmp_path / "pipe"))
+    out2 = reloaded.transform(df).collect()
+    preds2 = np.array([r["prediction"] for r in out2])
+    np.testing.assert_array_equal(preds2, preds)
+
+
+def test_unfitted_lr_roundtrip(tmp_path, blobs_df):
+    df, _, y = blobs_df
+    lr = LogisticRegression(maxIter=150, regParam=0.01, tol=1e-5)
+    lr.save(str(tmp_path / "lr"))
+    lr2 = load(str(tmp_path / "lr"))
+    assert isinstance(lr2, LogisticRegression)
+    assert lr2.getMaxIter() == 150 and lr2.getRegParam() == 0.01
+    m1, m2 = lr.fit(df), lr2.fit(df)
+    np.testing.assert_allclose(m2.coefficients, m1.coefficients,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_model_roundtrip(tmp_path, blobs_df):
+    df, _, y = blobs_df
+    model = LogisticRegression(maxIter=100).fit(df)
+    model.save(str(tmp_path / "lrm"))
+    model2 = load(str(tmp_path / "lrm"))
+    assert isinstance(model2, LogisticRegressionModel)
+    p1 = [r["prediction"] for r in model.transform(df).collect()]
+    p2 = [r["prediction"] for r in model2.transform(df).collect()]
+    assert p1 == p2
+
+
+def test_bad_labels_raise(rng):
+    rows = [{"features": [0.0, 1.0], "label": "cat"}]
+    df = DataFrame.fromRows(rows)
+    with pytest.raises(ValueError, match="numeric class"):
+        LogisticRegression(maxIter=5).fit(df)
+
+
+def test_all_null_partition_transform(blobs_df):
+    df, _, _ = blobs_df
+    model = LogisticRegression(maxIter=30).fit(df)
+    nulls = DataFrame.fromRows([{"features": None, "label": 0},
+                                {"features": None, "label": 1}],
+                               numPartitions=1)
+    out = model.transform(nulls).collect()
+    assert all(r["prediction"] is None for r in out)

@@ -427,3 +427,19 @@ def test_validation_data_under_mesh_any_size(labeled_image_df, rng):
                         "learning_rate": 0.05, "validation_data": (vx, vy)})
     model = est.fit(labeled_image_df)
     assert "val_loss" in model.history["epochs"][0]
+
+
+def test_validation_data_wins_over_split(labeled_image_df, rng):
+    """keras precedence: explicit validation_data overrides the split."""
+    vx = rng.uniform(0, 255, size=(4, 8, 8, 3)).astype(np.float32)
+    vy = np.array([0, 1, 0, 1])
+    est = KerasImageFileEstimator(
+        inputCol="uri", outputCol="preds", labelCol="label",
+        model=_tiny_cnn(),
+        kerasFitParams={"epochs": 1, "batch_size": 8, "seed": 0,
+                        "streaming": False, "validation_split": 0.5,
+                        "validation_data": (vx, vy)})
+    model = est.fit(labeled_image_df)
+    # all 24 train rows used (no split): 3 full batches of 8
+    # and the val metrics come from the 4 explicit rows
+    assert "val_loss" in model.history["epochs"][0]

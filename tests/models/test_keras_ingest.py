@@ -245,3 +245,44 @@ def test_duplicate_output_names_rejected(rng):
     m = keras.Model([a_in, b_in], [shared(a_in), shared(b_in)])
     with pytest.raises(ValueError, match="not unique"):
         keras_to_model_function(m)
+
+
+def test_normalization_layer(rng):
+    """keras preprocessing Normalization (EfficientNet/ConvNeXt stems):
+    explicit mean/variance, both directions, oracle-exact."""
+    mean, var = [1.0, 2.0, 3.0], [4.0, 1.0, 0.25]
+    for invert in (False, True):
+        m = keras.Sequential([
+            keras.Input((3,)),
+            layers.Normalization(mean=mean, variance=var, invert=invert)])
+        mf = keras_to_model_function(m)
+        x = rng.normal(size=(5, 3)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(mf.apply_fn(mf.variables, x)), np.asarray(m(x)),
+            rtol=1e-6, atol=1e-6)
+
+
+def test_layernorm_and_hard_silu(rng):
+    """LayerNormalization + hard_silu (MobileNetV3's activation) ingest
+    and match keras exactly."""
+    m = keras.Sequential([
+        keras.Input((6, 4)),
+        layers.LayerNormalization(epsilon=1e-5),
+        layers.Activation("hard_silu"),
+        layers.Dense(2)])
+    mf = keras_to_model_function(m)
+    x = rng.normal(size=(3, 6, 4)).astype(np.float32) * 5
+    np.testing.assert_allclose(
+        np.asarray(mf.apply_fn(mf.variables, x)), np.asarray(m(x)),
+        rtol=1e-5, atol=1e-5)
+
+
+def test_layernorm_no_scale_center(rng):
+    m = keras.Sequential([
+        keras.Input((8,)),
+        layers.LayerNormalization(center=False, scale=False)])
+    mf = keras_to_model_function(m)
+    x = rng.normal(size=(4, 8)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(mf.apply_fn(mf.variables, x)), np.asarray(m(x)),
+        rtol=1e-5, atol=1e-6)
