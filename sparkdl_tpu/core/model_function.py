@@ -246,6 +246,28 @@ class ModelFunction:
         return ModelFunction(fn, self.variables, self.input_spec, name=self.name,
                              trainable_mask=self.trainable_mask)
 
+    def with_compute_dtype(self, dtype) -> "ModelFunction":
+        """Run this model in ``dtype`` (e.g. bfloat16 for MXU inference):
+        float weights cast once here, input casts in-program, output casts
+        back to the original output dtype. Used by the registry's
+        ingestion-backed named models, whose keras-derived apply is
+        float32 by construction."""
+        import jax.numpy as jnp
+
+        dtype = jnp.dtype(dtype)
+        apply_fn = self.apply_fn
+        variables = jax.tree.map(
+            lambda a: a.astype(dtype)
+            if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
+            else a, self.variables)
+
+        def fn(vs, x):
+            out = apply_fn(vs, x.astype(dtype))
+            return jax.tree.map(lambda o: o.astype(jnp.float32), out)
+
+        return ModelFunction(fn, variables, self.input_spec, name=self.name,
+                             trainable_mask=self.trainable_mask)
+
     def flattened(self) -> "ModelFunction":
         """Flatten outputs to (batch, -1) — the ``buildFlattener`` analog.
 

@@ -23,7 +23,8 @@ from __future__ import annotations
 import concurrent.futures as _futures
 import os
 import threading
-from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import (Any, Callable, Dict, Iterable, List, Optional, Sequence,
+                    Tuple, Union)
 
 import numpy as np
 import pandas as pd

@@ -102,8 +102,14 @@ class _NamedImageTransformer(Transformer, HasInputCol, HasOutputCol,
         return mf
 
     def copy(self, extra=None):
+        # Copies SHARE the built-model cache: entries validate against the
+        # exact weights value, so a copy that changes weights rebuilds,
+        # while a paramMap copy (e.g. transform(df, {batchSize: 32})) keeps
+        # the same built model — essential for ingested names, whose
+        # keras init is unseeded (a rebuild would produce DIFFERENT
+        # random weights and incompatible features).
         that = super().copy(extra)
-        that._mf_cache = {}
+        that._mf_cache = dict(self._mf_cache)
         return that
 
     # -- persistence (SURVEY.md §5.4; see ml/persistence.py) -----------------
@@ -118,8 +124,11 @@ class _NamedImageTransformer(Transformer, HasInputCol, HasOutputCol,
         params["dtype"] = P.dtype_name(self.getDtype())
         artifacts = {}
         weights = self.getWeights()
-        if isinstance(weights, str) and weights == "random":
-            # seeded init: rebuilding with the same marker reproduces it
+        if (isinstance(weights, str) and weights == "random"
+                and not registry.is_ingested_model(self.getModelName())):
+            # seeded Flax init: rebuilding with the same marker reproduces
+            # it exactly. Ingested models' keras init is NOT seeded, so
+            # they fall through and persist the actual weights.
             params["weights"] = "random"
         else:
             mf = self._model_function(self._persist_kind)

@@ -53,6 +53,18 @@ def preprocess_identity(x: jnp.ndarray) -> jnp.ndarray:
     return x
 
 
+_TORCH_MEAN = (0.485, 0.456, 0.406)
+_TORCH_STD = (0.229, 0.224, 0.225)
+
+
+def preprocess_torch_mode(x: jnp.ndarray) -> jnp.ndarray:
+    """keras 'torch' mode: [0,1] scale then ImageNet RGB mean/std."""
+    x = x / 255.0
+    mean = jnp.asarray(_TORCH_MEAN, dtype=x.dtype)
+    std = jnp.asarray(_TORCH_STD, dtype=x.dtype)
+    return (x - mean) / std
+
+
 @dataclass(frozen=True)
 class ModelSpec:
     name: str
@@ -88,7 +100,32 @@ SUPPORTED_MODELS: Dict[str, ModelSpec] = {
         "TestNet", TestNet, (32, 32), preprocess_tf_mode, 16, classes=10),
 }
 
-SUPPORTED_MODEL_NAMES = sorted(SUPPORTED_MODELS)
+# Ingestion-backed named models (r4): families WITHOUT an in-repo Flax
+# definition serve through the generic keras layer-DAG walker
+# (models/keras_ingest.py, oracle-exact per family) — DeepImageFeaturizer/
+# Predictor accept these names exactly like the Flax-native ones. Weights:
+# "random" (keras init) or an .h5/.keras file. Device preprocess follows
+# each family's keras contract (EfficientNet/MobileNetV3 normalize
+# in-model, so identity).
+_INGESTED_MODELS: Dict[str, ModelSpec] = {
+    "DenseNet121": ModelSpec(
+        "DenseNet121", None, (224, 224), preprocess_torch_mode, 1024),
+    "EfficientNetB0": ModelSpec(
+        "EfficientNetB0", None, (224, 224), preprocess_identity, 1280),
+    "MobileNetV3Small": ModelSpec(
+        "MobileNetV3Small", None, (224, 224), preprocess_identity, 576),
+    "NASNetMobile": ModelSpec(
+        "NASNetMobile", None, (224, 224), preprocess_tf_mode, 1056),
+}
+
+_INGESTED_BUILDERS = {
+    "DenseNet121": ("densenet", "DenseNet121"),
+    "EfficientNetB0": ("efficientnet", "EfficientNetB0"),
+    "MobileNetV3Small": (None, "MobileNetV3Small"),  # top-level export only
+    "NASNetMobile": ("nasnet", "NASNetMobile"),
+}
+
+SUPPORTED_MODEL_NAMES = sorted(SUPPORTED_MODELS) + sorted(_INGESTED_MODELS)
 
 # keras.applications builders for weight-bearing named models (used when the
 # user asks for keras-initialized weights, or in oracle tests).
@@ -103,12 +140,88 @@ _KERAS_BUILDERS = {
 
 
 def get_model_spec(name: str) -> ModelSpec:
-    try:
-        return SUPPORTED_MODELS[name]
-    except KeyError:
+    spec = SUPPORTED_MODELS.get(name) or _INGESTED_MODELS.get(name)
+    if spec is None:
         raise ValueError(
-            f"Unsupported model {name!r}; supported: {SUPPORTED_MODEL_NAMES}"
-        ) from None
+            f"Unsupported model {name!r}; supported: {SUPPORTED_MODEL_NAMES}")
+    return spec
+
+
+def is_ingested_model(name: str) -> bool:
+    return name in _INGESTED_MODELS
+
+
+def _build_ingested(name: str, weights, include_top: bool,
+                    dtype) -> ModelFunction:
+    """Named model via keras build + generic ingestion (no Flax def)."""
+    import importlib
+
+    import keras
+
+    from sparkdl_tpu.models.keras_ingest import keras_to_model_function
+
+    spec = _INGESTED_MODELS[name]
+    h, w = spec.input_size
+    msgpack_path = None
+    if isinstance(weights, str) and weights.endswith((".h5", ".keras")):
+        from sparkdl_tpu.models.convert import load_keras_file
+
+        model = load_keras_file(weights)
+    elif hasattr(weights, "layers"):
+        model = weights
+    else:
+        # "random" (keras-initialized architecture) or a msgpack weights
+        # file saved by this framework (named-model persistence). Anything
+        # else raises — a silent random fallback would discard the user's
+        # weights (the Flax path raises the same way, _resolve_variables).
+        if weights is not None and not isinstance(weights, str):
+            raise TypeError(
+                f"Cannot resolve weights for ingested model {name!r} from "
+                f"{type(weights).__name__}; pass 'random', a Keras model "
+                "object, an .h5/.keras file, or a msgpack file saved by "
+                "this framework")
+        if isinstance(weights, str) and weights not in ("random",):
+            msgpack_path = weights
+        module_name, attr = _INGESTED_BUILDERS[name]
+        ctor = (getattr(keras.applications, attr) if module_name is None
+                else getattr(importlib.import_module(
+                    f"keras.applications.{module_name}"), attr))
+        kwargs = {"weights": None, "input_shape": (h, w, 3)}
+        if include_top:
+            kwargs["classes"] = spec.classes
+        else:
+            kwargs.update(include_top=False, pooling="avg")
+        model = ctor(**kwargs)
+    mf = keras_to_model_function(
+        model, name=f"{name}_{'predict' if include_top else 'featurize'}")
+    # A user-supplied model/file is ingested verbatim — verify its output
+    # matches the requested role instead of silently serving a classifier
+    # head as "features" (the Flax path re-builds the headless
+    # architecture; ingestion cannot, so it checks).
+    out = jax.eval_shape(mf.apply_fn, mf.variables,
+                         jnp.zeros((1, h, w, 3), jnp.float32))
+    if out.ndim != 2:
+        raise ValueError(
+            f"Ingested {name!r} model emits shape {out.shape}; expected a "
+            "(batch, features) head — save the model with "
+            "include_top=False, pooling='avg'"
+            if not include_top else
+            f"Ingested {name!r} model emits shape {out.shape}; expected "
+            "(batch, classes) probabilities")
+    if not include_top and out.shape[-1] != spec.feature_dim:
+        raise ValueError(
+            f"Ingested {name!r} model emits {out.shape[-1]}-dim output but "
+            f"the featurizer contract for this name is {spec.feature_dim} "
+            "features — pass a headless (include_top=False, pooling='avg') "
+            "model")
+    if msgpack_path is not None:
+        import flax.serialization as fser
+
+        with open(msgpack_path, "rb") as f:
+            mf.variables = fser.from_bytes(mf.variables, f.read())
+    if dtype is not None:
+        mf = mf.with_compute_dtype(dtype)
+    return mf
 
 
 def _resolve_variables(spec: ModelSpec, module, weights, seed: int,
@@ -192,6 +305,12 @@ def build_featurizer(name: str, weights="random", seed: int = 0,
     inference-specialized fast path exists.
     """
     spec = get_model_spec(name)
+    if is_ingested_model(name):
+        mf = _build_ingested(name, weights, include_top=False, dtype=dtype)
+        if preprocess:
+            mf = mf.with_preprocess(spec.preprocess)
+        mf.fast_path = False
+        return mf
     kwargs = dict(spec.featurize_kwargs or {"include_top": False,
                                             "pooling": "avg"})
     kwargs["dtype"] = dtype
@@ -216,6 +335,12 @@ def build_predictor(name: str, weights="random", seed: int = 0,
                     fast: bool = True) -> ModelFunction:
     """Full named model (softmax probabilities) as a ModelFunction."""
     spec = get_model_spec(name)
+    if is_ingested_model(name):
+        mf = _build_ingested(name, weights, include_top=True, dtype=dtype)
+        if preprocess:
+            mf = mf.with_preprocess(spec.preprocess)
+        mf.fast_path = False
+        return mf
     module = spec.builder(include_top=True, classes=spec.classes, dtype=dtype)
     input_spec = _spec_input(spec)
     variables = _resolve_variables(spec, module, weights, seed, input_spec)

@@ -105,3 +105,73 @@ def test_featurizer_param_copy_isolated(image_df):
     g = f.copy({f.batchSize: 2})
     assert g.getBatchSize() == 2
     assert f.getBatchSize() == 64
+
+
+def test_ingested_named_featurizer_and_persistence(rng, tmp_path):
+    """Registry names WITHOUT a Flax definition (r4: DenseNet121,
+    EfficientNetB0, MobileNetV3Small, NASNetMobile) serve through generic
+    keras ingestion. Keras init is unseeded, so persistence must save the
+    actual weights — the reloaded stage reproduces outputs exactly."""
+    pytest.importorskip("keras")
+    from sparkdl_tpu.ml import load
+
+    rows = [{"image": imageIO.imageArrayToStruct(
+        rng.integers(0, 255, size=(64, 64, 3), dtype=np.uint8),
+        origin=str(i))} for i in range(3)]
+    df = DataFrame.fromRows(
+        rows, schema=pa.schema([pa.field("image", imageIO.imageSchema)]),
+        numPartitions=1)
+    t = DeepImageFeaturizer(inputCol="image", outputCol="f",
+                            modelName="MobileNetV3Small", batchSize=2)
+    out = t.transform(df).collect()
+    feats = np.array([r["f"] for r in out], np.float32)
+    assert feats.shape == (3, 576)
+    t.save(str(tmp_path / "ingested"))
+    t2 = load(str(tmp_path / "ingested"))
+    feats2 = np.array([r["f"] for r in t2.transform(df).collect()],
+                      np.float32)
+    np.testing.assert_allclose(feats2, feats, rtol=1e-5, atol=1e-6)
+
+
+def test_ingested_model_names_listed():
+    from sparkdl_tpu.models import registry
+
+    for name in ("DenseNet121", "EfficientNetB0", "MobileNetV3Small",
+                 "NASNetMobile"):
+        assert name in registry.SUPPORTED_MODEL_NAMES
+        assert registry.is_ingested_model(name)
+        spec = registry.get_model_spec(name)
+        assert spec.input_size == (224, 224)
+
+
+def test_ingested_copy_shares_built_model(rng):
+    """A paramMap copy of an ingested-name stage reuses the SAME built
+    model (keras init is unseeded — a rebuild would emit incompatible
+    features)."""
+    pytest.importorskip("keras")
+    rows = [{"image": imageIO.imageArrayToStruct(
+        rng.integers(0, 255, size=(48, 48, 3), dtype=np.uint8))}
+        for _ in range(2)]
+    df = DataFrame.fromRows(
+        rows, schema=pa.schema([pa.field("image", imageIO.imageSchema)]),
+        numPartitions=1)
+    t = DeepImageFeaturizer(inputCol="image", outputCol="f",
+                            modelName="MobileNetV3Small", batchSize=2)
+    a = np.array([r["f"] for r in t.transform(df).collect()], np.float32)
+    b = np.array([r["f"] for r in t.transform(
+        df, {t.batchSize: 4}).collect()], np.float32)
+    np.testing.assert_allclose(b, a, rtol=1e-5, atol=1e-6)
+
+
+def test_ingested_rejects_bad_weights_and_wrong_head(rng, tmp_path):
+    from sparkdl_tpu.models import registry
+
+    with pytest.raises(TypeError, match="Cannot resolve weights"):
+        registry.build_featurizer("MobileNetV3Small",
+                                  weights={"params": {}})
+    # a full model (with classifier head) supplied to the featurizer role
+    keras = pytest.importorskip("keras")
+    full = keras.applications.MobileNetV3Small(
+        weights=None, classes=7, input_shape=(224, 224, 3))
+    with pytest.raises(ValueError, match="features"):
+        registry.build_featurizer("MobileNetV3Small", weights=full)
