@@ -262,7 +262,11 @@ class ModelFunction:
             else a, self.variables)
 
         def fn(vs, x):
-            out = apply_fn(vs, x.astype(dtype))
+            # jnp.asarray first: an eager numpy input would otherwise flow
+            # numpy's promotion rules through the graph (np-bf16 * python
+            # float -> f32, unlike JAX's weak-type rules) and break
+            # dtype-strict convs mid-model
+            out = apply_fn(vs, jnp.asarray(x).astype(dtype))
             return jax.tree.map(lambda o: o.astype(jnp.float32), out)
 
         return ModelFunction(fn, variables, self.input_spec, name=self.name,

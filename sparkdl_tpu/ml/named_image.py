@@ -124,12 +124,23 @@ class _NamedImageTransformer(Transformer, HasInputCol, HasOutputCol,
         params["dtype"] = P.dtype_name(self.getDtype())
         artifacts = {}
         weights = self.getWeights()
+        ingested = registry.is_ingested_model(self.getModelName())
         if (isinstance(weights, str) and weights == "random"
-                and not registry.is_ingested_model(self.getModelName())):
+                and not ingested):
             # seeded Flax init: rebuilding with the same marker reproduces
             # it exactly. Ingested models' keras init is NOT seeded, so
             # they fall through and persist the actual weights.
             params["weights"] = "random"
+        elif ingested and (hasattr(weights, "layers") or (
+                isinstance(weights, str)
+                and weights.endswith((".h5", ".keras")))):
+            # a user-supplied Keras model/file may be a CUSTOM graph (the
+            # role check only validates the output head) — msgpack weights
+            # alone could not restore it (the canonical-architecture
+            # template wouldn't match), so persist the model itself via
+            # Keras serialization; load re-ingests the saved graph.
+            artifacts["keras_model"] = P.save_keras_artifact(
+                _KerasPayload(weights), path)
         else:
             mf = self._model_function(self._persist_kind)
             artifacts["weights"] = P.save_weights_msgpack(mf.variables, path)
@@ -141,10 +152,28 @@ class _NamedImageTransformer(Transformer, HasInputCol, HasOutputCol,
         dtype = kwargs.pop("dtype", None)
         if "weights" in meta["artifacts"]:
             kwargs["weights"] = os.path.join(path, meta["artifacts"]["weights"])
+        elif "keras_model" in meta["artifacts"]:
+            kwargs["weights"] = os.path.join(path,
+                                             meta["artifacts"]["keras_model"])
         inst = cls(**kwargs)
         if dtype is not None:
             inst.setDtype(np.dtype(dtype))
         return inst
+
+
+class _KerasPayload:
+    """Adapter: a weights value (Keras model object or file path) exposed
+    through persistence.save_keras_artifact's getModel/getModelFile
+    protocol."""
+
+    def __init__(self, weights) -> None:
+        self._weights = weights
+
+    def getModel(self):
+        return self._weights if hasattr(self._weights, "layers") else None
+
+    def getModelFile(self):
+        return self._weights if isinstance(self._weights, str) else None
 
 
 class DeepImageFeaturizer(_NamedImageTransformer):

@@ -224,9 +224,12 @@ def _convert_layer(layer, input_rank=None) -> Callable[[List[jnp.ndarray]], Call
         std = jnp.maximum(
             jnp.sqrt(jnp.asarray(np.asarray(layer.variance), jnp.float32)),
             _keras.config.epsilon())
+        # cast the baked constants to the INPUT dtype: f32 constants would
+        # promote a bf16 activation back to f32 mid-graph
+        # (with_compute_dtype inference) and break dtype-strict convs
         if bool(getattr(layer, "invert", False)):
-            return lambda w, x: mean + x * std
-        return lambda w, x: (x - mean) / std
+            return lambda w, x: mean.astype(x.dtype) + x * std.astype(x.dtype)
+        return lambda w, x: (x - mean.astype(x.dtype)) / std.astype(x.dtype)
 
     if cls == "LayerNormalization":
         axis = layer.axis

@@ -125,6 +125,25 @@ _INGESTED_BUILDERS = {
     "NASNetMobile": ("nasnet", "NASNetMobile"),
 }
 
+
+def _resolve_keras_ctor(name: str):
+    """keras.applications constructor for any supported named model
+    (shared by the ingestion builder and build_keras_reference)."""
+    import importlib
+
+    import keras
+
+    entry = _KERAS_BUILDERS.get(name) or _INGESTED_BUILDERS.get(name)
+    if entry is None:
+        raise ValueError(
+            f"No keras.applications counterpart for {name!r}; available: "
+            f"{sorted(set(_KERAS_BUILDERS) | set(_INGESTED_BUILDERS))}")
+    module_name, attr = entry
+    if module_name is None:
+        return getattr(keras.applications, attr)
+    return getattr(importlib.import_module(
+        f"keras.applications.{module_name}"), attr)
+
 SUPPORTED_MODEL_NAMES = sorted(SUPPORTED_MODELS) + sorted(_INGESTED_MODELS)
 
 # keras.applications builders for weight-bearing named models (used when the
@@ -154,10 +173,6 @@ def is_ingested_model(name: str) -> bool:
 def _build_ingested(name: str, weights, include_top: bool,
                     dtype) -> ModelFunction:
     """Named model via keras build + generic ingestion (no Flax def)."""
-    import importlib
-
-    import keras
-
     from sparkdl_tpu.models.keras_ingest import keras_to_model_function
 
     spec = _INGESTED_MODELS[name]
@@ -182,10 +197,7 @@ def _build_ingested(name: str, weights, include_top: bool,
                 "this framework")
         if isinstance(weights, str) and weights not in ("random",):
             msgpack_path = weights
-        module_name, attr = _INGESTED_BUILDERS[name]
-        ctor = (getattr(keras.applications, attr) if module_name is None
-                else getattr(importlib.import_module(
-                    f"keras.applications.{module_name}"), attr))
+        ctor = _resolve_keras_ctor(name)
         kwargs = {"weights": None, "input_shape": (h, w, 3)}
         if include_top:
             kwargs["classes"] = spec.classes
@@ -200,6 +212,11 @@ def _build_ingested(name: str, weights, include_top: bool,
     # architecture; ingestion cannot, so it checks).
     out = jax.eval_shape(mf.apply_fn, mf.variables,
                          jnp.zeros((1, h, w, 3), jnp.float32))
+    if not hasattr(out, "ndim"):  # multi-output graph -> dict of outputs
+        raise ValueError(
+            f"Ingested {name!r} model has multiple outputs; named "
+            "featurizers/predictors bind ONE output column — serve "
+            "multi-IO models via TPUTransformer instead")
     if out.ndim != 2:
         raise ValueError(
             f"Ingested {name!r} model emits shape {out.shape}; expected a "
@@ -359,9 +376,6 @@ def build_predictor(name: str, weights="random", seed: int = 0,
 
 def build_keras_reference(name: str):
     """Instantiate the same architecture in keras (weights=None) — used by
-    oracle tests and by users wanting keras-side verification."""
-    import importlib
-
-    module_name, attr = _KERAS_BUILDERS[name]
-    mod = importlib.import_module(f"keras.applications.{module_name}")
-    return getattr(mod, attr)(weights=None)
+    oracle tests and by users wanting keras-side verification. Covers the
+    Flax-native AND ingestion-backed named models."""
+    return _resolve_keras_ctor(name)(weights=None)

@@ -175,3 +175,43 @@ def test_ingested_rejects_bad_weights_and_wrong_head(rng, tmp_path):
         weights=None, classes=7, input_shape=(224, 224, 3))
     with pytest.raises(ValueError, match="features"):
         registry.build_featurizer("MobileNetV3Small", weights=full)
+
+
+def test_ingested_custom_graph_persistence(rng, tmp_path):
+    """A CUSTOM Keras graph supplied as weights for an ingested name
+    (only the output head is validated) must survive save/load — the
+    stage persists the model itself via Keras serialization, since
+    msgpack weights could not restore a non-canonical architecture."""
+    keras = pytest.importorskip("keras")
+    from keras import layers as L
+
+    from sparkdl_tpu.ml import load
+
+    custom = keras.Sequential([
+        keras.Input((224, 224, 3)),
+        L.Conv2D(8, 3, strides=8, padding="same"),
+        L.GlobalAveragePooling2D(),
+        L.Dense(576)])  # matches MobileNetV3Small's 576-dim contract
+    rows = [{"image": imageIO.imageArrayToStruct(
+        rng.integers(0, 255, size=(32, 32, 3), dtype=np.uint8))}
+        for _ in range(2)]
+    df = DataFrame.fromRows(
+        rows, schema=pa.schema([pa.field("image", imageIO.imageSchema)]),
+        numPartitions=1)
+    t = DeepImageFeaturizer(inputCol="image", outputCol="f",
+                            modelName="MobileNetV3Small", weights=custom,
+                            batchSize=2)
+    want = np.array([r["f"] for r in t.transform(df).collect()], np.float32)
+    t.save(str(tmp_path / "custom"))
+    t2 = load(str(tmp_path / "custom"))
+    got = np.array([r["f"] for r in t2.transform(df).collect()], np.float32)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_keras_reference_covers_ingested_names():
+    from sparkdl_tpu.models import registry
+
+    ctor = registry._resolve_keras_ctor("DenseNet121")
+    assert ctor.__name__ == "DenseNet121"
+    with pytest.raises(ValueError, match="counterpart"):
+        registry._resolve_keras_ctor("NoSuchNet")

@@ -286,3 +286,27 @@ def test_layernorm_no_scale_center(rng):
     np.testing.assert_allclose(
         np.asarray(mf.apply_fn(mf.variables, x)), np.asarray(m(x)),
         rtol=1e-5, atol=1e-6)
+
+
+def test_normalization_bf16_compute(rng):
+    """with_compute_dtype(bf16) over an EfficientNet-style stem
+    (Rescaling -> Normalization -> Conv): two r4 bugs covered — baked
+    Normalization constants must follow the activation dtype, and an
+    EAGER numpy input must not flow numpy promotion rules (np-bf16 *
+    python float -> f32) into dtype-strict convs."""
+    import jax.numpy as jnp
+
+    m = keras.Sequential([
+        keras.Input((8, 8, 3)),
+        layers.Rescaling(1 / 255.0),
+        layers.Normalization(mean=[0.5, 0.4, 0.3], variance=[1., 2., 3.]),
+        layers.Conv2D(4, 3, padding="same"),
+        layers.GlobalAveragePooling2D()])
+    mf = keras_to_model_function(m).with_compute_dtype(jnp.bfloat16)
+    x = (rng.uniform(0, 255, size=(2, 8, 8, 3))).astype(np.float32)
+    out = np.asarray(mf.apply_fn(mf.variables, x))   # EAGER numpy input
+    want = np.asarray(m(x))
+    assert out.dtype == np.float32
+    np.testing.assert_allclose(out, want, rtol=0.05, atol=0.02)
+    jout = np.asarray(__import__("jax").jit(mf.apply_fn)(mf.variables, x))
+    np.testing.assert_allclose(jout, out, rtol=0.02, atol=0.01)
