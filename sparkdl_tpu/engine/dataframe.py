@@ -554,6 +554,30 @@ class DataFrame:
                 raise KeyError(f"No such column: {c!r}")
         return GroupedData(self, list(cols))
 
+    def randomSplit(self, weights: Sequence[float],
+                    seed: int = 0) -> List["DataFrame"]:
+        """Split rows into len(weights) disjoint frames (Spark's
+        randomSplit: weights normalize; assignment is a seeded global
+        permutation, so splits are deterministic, disjoint, exhaustive —
+        the backbone of CrossValidator/TrainValidationSplit)."""
+        if not weights or any(w <= 0 for w in weights):
+            raise ValueError(f"weights must be positive, got {weights}")
+        table = self.toArrow()
+        n = table.num_rows
+        perm = np.random.default_rng(seed).permutation(n)
+        total = float(sum(weights))
+        bounds = np.cumsum([w / total for w in weights])
+        out: List["DataFrame"] = []
+        start = 0
+        for i, b in enumerate(bounds):
+            stop = n if i == len(weights) - 1 else int(round(b * n))
+            idx = np.sort(perm[start:stop])
+            out.append(DataFrame.fromArrow(
+                table.take(pa.array(idx, type=pa.int64())),
+                numPartitions=max(1, self.numPartitions)))
+            start = stop
+        return out
+
     def cache(self) -> "DataFrame":
         self._materialize()
         return self

@@ -20,6 +20,17 @@ from sparkdl_tpu.ml.classification import (
     LogisticRegressionModel,
 )
 from sparkdl_tpu.ml.estimator import KerasImageFileEstimator, KerasImageFileModel
+from sparkdl_tpu.ml.evaluation import (
+    MulticlassClassificationEvaluator,
+    RegressionEvaluator,
+)
+from sparkdl_tpu.ml.tuning import (
+    CrossValidator,
+    CrossValidatorModel,
+    ParamGridBuilder,
+    TrainValidationSplit,
+    TrainValidationSplitModel,
+)
 from sparkdl_tpu.ml.image_transformer import TPUImageTransformer
 from sparkdl_tpu.ml.keras_image import KerasImageFileTransformer
 from sparkdl_tpu.ml.keras_tensor import KerasTransformer
@@ -33,9 +44,16 @@ TFImageTransformer = TPUImageTransformer
 TFTransformer = TPUTransformer
 
 __all__ = [
+    "CrossValidator",
+    "CrossValidatorModel",
     "DeepImageFeaturizer",
     "DeepImagePredictor",
     "Estimator",
+    "MulticlassClassificationEvaluator",
+    "ParamGridBuilder",
+    "RegressionEvaluator",
+    "TrainValidationSplit",
+    "TrainValidationSplitModel",
     "KerasImageFileEstimator",
     "KerasImageFileModel",
     "KerasImageFileTransformer",

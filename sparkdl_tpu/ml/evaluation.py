@@ -1,0 +1,147 @@
+"""Evaluators — the metric side of model selection.
+
+Parity: Spark ML's ``MulticlassClassificationEvaluator`` /
+``RegressionEvaluator`` are what the reference's documented HPO workflow
+(``CrossValidator(estimator=KerasImageFileEstimator, ...)``, upstream
+README) plugged in as ``evaluator``. Same param surface
+(``predictionCol/labelCol/metricName``, ``evaluate(df) -> float``,
+``isLargerBetter``), computed with numpy over the engine frame.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from sparkdl_tpu.param.base import Param, Params, keyword_only
+from sparkdl_tpu.param.converters import SparkDLTypeConverters
+from sparkdl_tpu.param.shared_params import HasLabelCol
+
+
+class Evaluator(Params):
+    """``evaluate(dataset) -> float`` + ``isLargerBetter()``."""
+
+    def evaluate(self, dataset) -> float:
+        raise NotImplementedError
+
+    def isLargerBetter(self) -> bool:
+        return True
+
+
+class _HasPredictionCol(Params):
+    predictionCol = Param(
+        "_HasPredictionCol", "predictionCol", "prediction column name",
+        typeConverter=SparkDLTypeConverters.toColumnName)
+
+    def setPredictionCol(self, value):
+        return self._set(predictionCol=value)
+
+    def getPredictionCol(self):
+        return self.getOrDefault(self.predictionCol)
+
+
+def _collect_pairs(dataset, prediction_col: str, label_col: str):
+    rows = dataset.select(prediction_col, label_col).collect()
+    pairs = [(r[prediction_col], r[label_col]) for r in rows
+             if r[prediction_col] is not None and r[label_col] is not None]
+    if not pairs:
+        raise ValueError("no non-null (prediction, label) rows to evaluate")
+    pred = np.asarray([p for p, _ in pairs], np.float64)
+    lab = np.asarray([l for _, l in pairs], np.float64)
+    return pred, lab
+
+
+class MulticlassClassificationEvaluator(Evaluator, _HasPredictionCol,
+                                        HasLabelCol):
+    """accuracy / f1 / weightedPrecision / weightedRecall over class-index
+    prediction+label columns (Spark's default metric is f1)."""
+
+    _METRICS = ("f1", "accuracy", "weightedPrecision", "weightedRecall")
+
+    metricName = Param("MulticlassClassificationEvaluator", "metricName",
+                       f"one of {_METRICS}",
+                       typeConverter=SparkDLTypeConverters.supportedNameConverter(list(_METRICS)))
+
+    @keyword_only
+    def __init__(self, *, predictionCol: str = "prediction",
+                 labelCol: str = "label",
+                 metricName: str = "f1") -> None:
+        super().__init__()
+        self._setDefault(predictionCol="prediction", labelCol="label",
+                         metricName="f1")
+        self._set(**self._input_kwargs)
+
+    def setMetricName(self, value):
+        return self._set(metricName=value)
+
+    def getMetricName(self):
+        return self.getOrDefault(self.metricName)
+
+    def evaluate(self, dataset) -> float:
+        pred, lab = _collect_pairs(dataset, self.getPredictionCol(),
+                                   self.getLabelCol())
+        metric = self.getMetricName()
+        if metric == "accuracy":
+            return float((pred == lab).mean())
+        classes = np.unique(np.concatenate([pred, lab]))
+        weights, precisions, recalls, f1s = [], [], [], []
+        for c in classes:
+            tp = float(((pred == c) & (lab == c)).sum())
+            fp = float(((pred == c) & (lab != c)).sum())
+            fn = float(((pred != c) & (lab == c)).sum())
+            support = tp + fn
+            p = tp / (tp + fp) if tp + fp > 0 else 0.0
+            r = tp / support if support > 0 else 0.0
+            f1 = 2 * p * r / (p + r) if p + r > 0 else 0.0
+            weights.append(support)
+            precisions.append(p)
+            recalls.append(r)
+            f1s.append(f1)
+        w = np.asarray(weights) / max(1.0, float(sum(weights)))
+        table = {"weightedPrecision": precisions, "weightedRecall": recalls,
+                 "f1": f1s}
+        return float(np.dot(w, table[metric]))
+
+
+class RegressionEvaluator(Evaluator, _HasPredictionCol, HasLabelCol):
+    """rmse / mse / mae / r2 over numeric prediction+label columns."""
+
+    _METRICS = ("rmse", "mse", "mae", "r2")
+
+    metricName = Param("RegressionEvaluator", "metricName",
+                       f"one of {_METRICS}",
+                       typeConverter=SparkDLTypeConverters.supportedNameConverter(list(_METRICS)))
+
+    @keyword_only
+    def __init__(self, *, predictionCol: str = "prediction",
+                 labelCol: str = "label",
+                 metricName: str = "rmse") -> None:
+        super().__init__()
+        self._setDefault(predictionCol="prediction", labelCol="label",
+                         metricName="rmse")
+        self._set(**self._input_kwargs)
+
+    def setMetricName(self, value):
+        return self._set(metricName=value)
+
+    def getMetricName(self):
+        return self.getOrDefault(self.metricName)
+
+    def isLargerBetter(self) -> bool:
+        return self.getMetricName() == "r2"
+
+    def evaluate(self, dataset) -> float:
+        pred, lab = _collect_pairs(dataset, self.getPredictionCol(),
+                                   self.getLabelCol())
+        err = pred - lab
+        metric = self.getMetricName()
+        if metric == "mse":
+            return float(np.mean(err ** 2))
+        if metric == "rmse":
+            return float(np.sqrt(np.mean(err ** 2)))
+        if metric == "mae":
+            return float(np.mean(np.abs(err)))
+        ss_res = float(np.sum(err ** 2))
+        ss_tot = float(np.sum((lab - lab.mean()) ** 2))
+        return 1.0 - ss_res / ss_tot if ss_tot > 0 else 0.0

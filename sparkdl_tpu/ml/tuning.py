@@ -1,0 +1,242 @@
+"""Model selection: ParamGridBuilder, CrossValidator, TrainValidationSplit.
+
+Parity: the reference's documented HPO workflow wrapped
+``KerasImageFileEstimator`` in **Spark ML's** CrossValidator (upstream
+README: "used with CrossValidator for hyperparameter search"). The
+rebuild ships the same three classes with Spark's semantics:
+
+- ``ParamGridBuilder().addGrid(p, values).build()`` → the cartesian list
+  of param maps.
+- ``CrossValidator``: k seeded folds (``DataFrame.randomSplit``); per
+  fold, ALL maps fit through the estimator's ``fitMultiple`` (which
+  shares one decode pass — and, via the ModelFunction step cache, one
+  compiled train step); metrics average across folds; the best map
+  refits on the full dataset.
+- ``TrainValidationSplit``: the single-split variant.
+
+Both produce a model wrapper exposing ``bestModel`` + the per-map
+metrics, transforming with the best model.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from sparkdl_tpu.ml.base import Estimator, Model
+from sparkdl_tpu.ml.evaluation import Evaluator
+from sparkdl_tpu.param.base import Param, Params, keyword_only
+from sparkdl_tpu.param.converters import TypeConverters
+
+ParamMap = Dict[Param, Any]
+
+
+class ParamGridBuilder:
+    """Cartesian param-map grid (Spark's builder API)."""
+
+    def __init__(self) -> None:
+        self._grid: Dict[Param, Sequence[Any]] = {}
+
+    def addGrid(self, param: Param, values: Sequence[Any]
+                ) -> "ParamGridBuilder":
+        if not isinstance(param, Param):
+            raise TypeError(f"addGrid needs a Param, got {type(param)}")
+        if not values:
+            raise ValueError(f"empty value list for {param.name}")
+        self._grid[param] = list(values)
+        return self
+
+    def baseOn(self, *args) -> "ParamGridBuilder":
+        """Fixed (param, value) pairs applied to every map."""
+        pairs = args[0].items() if len(args) == 1 and isinstance(
+            args[0], dict) else args
+        for param, value in pairs:
+            self.addGrid(param, [value])
+        return self
+
+    def build(self) -> List[ParamMap]:
+        params = list(self._grid)
+        if not params:
+            return [{}]
+        combos = itertools.product(*(self._grid[p] for p in params))
+        return [dict(zip(params, combo)) for combo in combos]
+
+
+class _ValidatorParams(Params):
+    seed = Param("_ValidatorParams", "seed", "fold/split seed",
+                 typeConverter=TypeConverters.toInt)
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._setDefault(seed=0)
+        self.estimator: Optional[Estimator] = None
+        self.evaluator: Optional[Evaluator] = None
+        self.estimatorParamMaps: List[ParamMap] = []
+
+    def setSeed(self, value):
+        return self._set(seed=value)
+
+    def getSeed(self):
+        return self.getOrDefault(self.seed)
+
+    def _check_configured(self) -> None:
+        if self.estimator is None or self.evaluator is None:
+            raise ValueError(
+                f"{type(self).__name__} needs estimator= and evaluator=")
+        if not self.estimatorParamMaps:
+            raise ValueError(
+                f"{type(self).__name__} needs a non-empty "
+                "estimatorParamMaps (ParamGridBuilder().build())")
+
+    def _fit_and_score(self, train, val) -> List[float]:
+        """Fit every map on ``train`` (shared-work fitMultiple) and score
+        its model on ``val``."""
+        maps = self.estimatorParamMaps
+        scores: List[Optional[float]] = [None] * len(maps)
+        for index, model in self.estimator.fitMultiple(train, maps):
+            scores[index] = float(
+                self.evaluator.evaluate(model.transform(val)))
+        return scores  # type: ignore[return-value]
+
+    def _best_index(self, metrics: Sequence[float]) -> int:
+        arr = np.asarray(metrics)
+        return int(np.argmax(arr) if self.evaluator.isLargerBetter()
+                   else np.argmin(arr))
+
+
+class CrossValidator(Estimator, _ValidatorParams):
+    """k-fold model selection over a param grid (Spark semantics)."""
+
+    numFolds = Param("CrossValidator", "numFolds", "number of folds (>= 2)",
+                     typeConverter=TypeConverters.toInt)
+
+    @keyword_only
+    def __init__(self, *, estimator: Optional[Estimator] = None,
+                 estimatorParamMaps: Optional[List[ParamMap]] = None,
+                 evaluator: Optional[Evaluator] = None,
+                 numFolds: int = 3, seed: int = 0) -> None:
+        super().__init__()
+        self._setDefault(numFolds=3)
+        kwargs = self._input_kwargs
+        self.estimator = kwargs.get("estimator")
+        self.evaluator = kwargs.get("evaluator")
+        self.estimatorParamMaps = list(kwargs.get("estimatorParamMaps") or [])
+        self._set(numFolds=kwargs.get("numFolds", 3),
+                  seed=kwargs.get("seed", 0))
+
+    def setNumFolds(self, value):
+        return self._set(numFolds=value)
+
+    def getNumFolds(self):
+        return self.getOrDefault(self.numFolds)
+
+    def _fit(self, dataset) -> "CrossValidatorModel":
+        self._check_configured()
+        k = self.getNumFolds()
+        if k < 2:
+            raise ValueError(f"numFolds must be >= 2, got {k}")
+        folds = dataset.randomSplit([1.0] * k, seed=self.getSeed())
+        n_maps = len(self.estimatorParamMaps)
+        totals = np.zeros(n_maps)
+        for i in range(k):
+            train = None
+            for j, fold in enumerate(folds):
+                if j == i:
+                    continue
+                train = fold if train is None else train.union(fold)
+            totals += np.asarray(self._fit_and_score(train, folds[i]))
+        avg = (totals / k).tolist()
+        best = self._best_index(avg)
+        best_model = self.estimator.fit(dataset,
+                                        self.estimatorParamMaps[best])
+        model = CrossValidatorModel(best_model, avg, best)
+        model._set_parent(self)
+        return model
+
+    def copy(self, extra=None):
+        that = super().copy(extra)
+        that.estimator = self.estimator
+        that.evaluator = self.evaluator
+        that.estimatorParamMaps = list(self.estimatorParamMaps)
+        return that
+
+
+class TrainValidationSplit(Estimator, _ValidatorParams):
+    """Single train/validation split model selection (Spark semantics)."""
+
+    trainRatio = Param("TrainValidationSplit", "trainRatio",
+                       "fraction of rows used for training (0, 1)",
+                       typeConverter=TypeConverters.toFloat)
+
+    @keyword_only
+    def __init__(self, *, estimator: Optional[Estimator] = None,
+                 estimatorParamMaps: Optional[List[ParamMap]] = None,
+                 evaluator: Optional[Evaluator] = None,
+                 trainRatio: float = 0.75, seed: int = 0) -> None:
+        super().__init__()
+        self._setDefault(trainRatio=0.75)
+        kwargs = self._input_kwargs
+        self.estimator = kwargs.get("estimator")
+        self.evaluator = kwargs.get("evaluator")
+        self.estimatorParamMaps = list(kwargs.get("estimatorParamMaps") or [])
+        self._set(trainRatio=kwargs.get("trainRatio", 0.75),
+                  seed=kwargs.get("seed", 0))
+
+    def setTrainRatio(self, value):
+        return self._set(trainRatio=value)
+
+    def getTrainRatio(self):
+        return self.getOrDefault(self.trainRatio)
+
+    def _fit(self, dataset) -> "TrainValidationSplitModel":
+        self._check_configured()
+        ratio = self.getTrainRatio()
+        if not 0.0 < ratio < 1.0:
+            raise ValueError(f"trainRatio must be in (0, 1), got {ratio}")
+        train, val = dataset.randomSplit([ratio, 1.0 - ratio],
+                                         seed=self.getSeed())
+        metrics = self._fit_and_score(train, val)
+        best = self._best_index(metrics)
+        best_model = self.estimator.fit(dataset,
+                                        self.estimatorParamMaps[best])
+        model = TrainValidationSplitModel(best_model, list(metrics), best)
+        model._set_parent(self)
+        return model
+
+    def copy(self, extra=None):
+        that = super().copy(extra)
+        that.estimator = self.estimator
+        that.evaluator = self.evaluator
+        that.estimatorParamMaps = list(self.estimatorParamMaps)
+        return that
+
+
+class _SelectionModel(Model):
+    def __init__(self, best_model: Model, metrics: List[float],
+                 best_index: int) -> None:
+        super().__init__()
+        self.bestModel = best_model
+        self.bestIndex = best_index
+
+    def _transform(self, dataset):
+        return self.bestModel.transform(dataset)
+
+
+class CrossValidatorModel(_SelectionModel):
+    """``bestModel`` + per-map ``avgMetrics`` (fold averages)."""
+
+    def __init__(self, best_model: Model, avg_metrics: List[float],
+                 best_index: int) -> None:
+        super().__init__(best_model, avg_metrics, best_index)
+        self.avgMetrics = avg_metrics
+
+
+class TrainValidationSplitModel(_SelectionModel):
+    """``bestModel`` + per-map ``validationMetrics``."""
+
+    def __init__(self, best_model: Model, metrics: List[float],
+                 best_index: int) -> None:
+        super().__init__(best_model, metrics, best_index)
+        self.validationMetrics = metrics

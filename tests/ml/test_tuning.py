@@ -1,0 +1,189 @@
+"""Model selection: ParamGridBuilder / CrossValidator /
+TrainValidationSplit + evaluators (the reference's documented HPO
+workflow wrapped KerasImageFileEstimator in Spark's CrossValidator).
+
+Oracles: grids are exact cartesian products; randomSplit is
+deterministic/disjoint/exhaustive; CV picks the paramMap that actually
+generalizes (a deliberately-crippled map must lose); evaluator metrics
+match hand-computed values.
+"""
+
+import numpy as np
+import pytest
+
+from sparkdl_tpu.engine.dataframe import DataFrame
+from sparkdl_tpu.ml import (
+    CrossValidator,
+    LogisticRegression,
+    MulticlassClassificationEvaluator,
+    ParamGridBuilder,
+    RegressionEvaluator,
+    TrainValidationSplit,
+)
+
+
+@pytest.fixture
+def blobs_df(rng):
+    centers = np.array([[4, 0, 0], [0, 4, 0], [0, 0, 4]], np.float32)
+    rows = []
+    for c in range(3):
+        pts = rng.normal(size=(30, 3)).astype(np.float32) * 0.5 + centers[c]
+        rows += [{"features": p.tolist(), "label": c} for p in pts]
+    order = rng.permutation(len(rows))
+    return DataFrame.fromRows([rows[i] for i in order], numPartitions=3)
+
+
+def test_param_grid_builder():
+    lr = LogisticRegression()
+    grid = (ParamGridBuilder()
+            .addGrid(lr.maxIter, [5, 50])
+            .addGrid(lr.regParam, [0.0, 1.0, 10.0])
+            .build())
+    assert len(grid) == 6
+    combos = {(m[lr.maxIter], m[lr.regParam]) for m in grid}
+    assert combos == {(a, b) for a in (5, 50) for b in (0.0, 1.0, 10.0)}
+    base = (ParamGridBuilder().baseOn({lr.tol: 1e-4})
+            .addGrid(lr.maxIter, [5]).build())
+    assert base == [{lr.tol: 1e-4, lr.maxIter: 5}]
+    with pytest.raises(ValueError):
+        ParamGridBuilder().addGrid(lr.maxIter, [])
+
+
+def test_random_split_properties(rng):
+    rows = [{"i": int(i)} for i in range(100)]
+    df = DataFrame.fromRows(rows, numPartitions=4)
+    a, b, c = df.randomSplit([0.5, 0.3, 0.2], seed=7)
+    ids = [set(r["i"] for r in part.collect()) for part in (a, b, c)]
+    assert sum(len(s) for s in ids) == 100
+    assert ids[0] | ids[1] | ids[2] == set(range(100))
+    assert not (ids[0] & ids[1]) and not (ids[1] & ids[2])
+    assert 40 <= len(ids[0]) <= 60
+    # deterministic in seed
+    a2, _, _ = df.randomSplit([0.5, 0.3, 0.2], seed=7)
+    assert set(r["i"] for r in a2.collect()) == ids[0]
+
+
+def test_cross_validator_picks_generalizing_map(blobs_df):
+    lr = LogisticRegression(maxIter=100)
+    grid = (ParamGridBuilder()
+            .addGrid(lr.regParam, [0.0, 1000.0])  # huge L2 cripples map 2
+            .build())
+    cv = CrossValidator(
+        estimator=lr, estimatorParamMaps=grid,
+        evaluator=MulticlassClassificationEvaluator(metricName="accuracy"),
+        numFolds=3, seed=1)
+    model = cv.fit(blobs_df)
+    assert len(model.avgMetrics) == 2
+    assert model.bestIndex == 0
+    assert model.avgMetrics[0] > model.avgMetrics[1]
+    assert model.avgMetrics[0] >= 0.95
+    out = model.transform(blobs_df).collect()  # delegates to bestModel
+    acc = np.mean([r["prediction"] == r["label"] for r in out])
+    assert acc >= 0.95
+
+
+def test_train_validation_split(blobs_df):
+    lr = LogisticRegression(maxIter=100)
+    grid = ParamGridBuilder().addGrid(lr.regParam, [0.0, 1000.0]).build()
+    tvs = TrainValidationSplit(
+        estimator=lr, estimatorParamMaps=grid,
+        evaluator=MulticlassClassificationEvaluator(metricName="accuracy"),
+        trainRatio=0.7, seed=2)
+    model = tvs.fit(blobs_df)
+    assert len(model.validationMetrics) == 2
+    assert model.bestIndex == 0
+    with pytest.raises(ValueError, match="trainRatio"):
+        TrainValidationSplit(estimator=lr, estimatorParamMaps=grid,
+                             evaluator=MulticlassClassificationEvaluator(),
+                             trainRatio=1.5).fit(blobs_df)
+
+
+def test_multiclass_evaluator_metrics():
+    rows = [{"prediction": p, "label": l} for p, l in
+            [(0, 0), (0, 0), (1, 0), (1, 1), (2, 1), (2, 2)]]
+    df = DataFrame.fromRows(rows)
+    acc = MulticlassClassificationEvaluator(metricName="accuracy").evaluate(df)
+    assert acc == pytest.approx(4 / 6)
+    # hand-computed weighted f1 over supports {0:3, 1:2, 2:1}
+    # class0: p=2/2? pred==0 twice both correct -> p=1, r=2/3, f1=0.8
+    # class1: pred==1 twice, 1 correct -> p=0.5, r=0.5, f1=0.5
+    # class2: pred==2 twice, 1 correct -> p=0.5, r=1.0, f1=2/3
+    want = (3 * 0.8 + 2 * 0.5 + 1 * (2 / 3)) / 6
+    f1 = MulticlassClassificationEvaluator(metricName="f1").evaluate(df)
+    assert f1 == pytest.approx(want)
+    assert MulticlassClassificationEvaluator().isLargerBetter()
+
+
+def test_regression_evaluator_metrics():
+    rows = [{"prediction": 1.0, "label": 2.0}, {"prediction": 3.0, "label": 3.0},
+            {"prediction": 5.0, "label": 4.0}]
+    df = DataFrame.fromRows(rows)
+    assert RegressionEvaluator(metricName="mse").evaluate(df) == \
+        pytest.approx(2 / 3)
+    assert RegressionEvaluator(metricName="mae").evaluate(df) == \
+        pytest.approx(2 / 3)
+    assert not RegressionEvaluator(metricName="rmse").isLargerBetter()
+    assert RegressionEvaluator(metricName="r2").isLargerBetter()
+    r2 = RegressionEvaluator(metricName="r2").evaluate(df)
+    assert r2 == pytest.approx(1.0 - (2 / 3) * 3 / 2.0)
+
+
+def test_cv_misconfiguration_raises(blobs_df):
+    lr = LogisticRegression()
+    with pytest.raises(ValueError, match="estimator"):
+        CrossValidator(estimatorParamMaps=[{}]).fit(blobs_df)
+    with pytest.raises(ValueError, match="ParamGridBuilder"):
+        CrossValidator(estimator=lr,
+                       evaluator=MulticlassClassificationEvaluator()
+                       ).fit(blobs_df)
+    with pytest.raises(ValueError, match="numFolds"):
+        CrossValidator(estimator=lr, estimatorParamMaps=[{}],
+                       evaluator=MulticlassClassificationEvaluator(),
+                       numFolds=1).fit(blobs_df)
+
+
+def test_cross_validator_over_keras_estimator(rng, tmp_path):
+    """The reference's documented workflow: CrossValidator wrapping
+    KerasImageFileEstimator (upstream README) — per fold, all maps train
+    through fitMultiple's shared decode + the ModelFunction step cache."""
+    keras = pytest.importorskip("keras")
+    from keras import layers
+    from PIL import Image
+
+    from sparkdl_tpu.ml import KerasImageFileEstimator
+
+    rows = []
+    for i in range(24):
+        label = i % 2
+        arr = rng.integers(0, 40, size=(8, 8, 3), dtype=np.uint8)
+        arr[..., label] += 180
+        p = tmp_path / f"img_{i}.png"
+        Image.fromarray(arr).save(p)
+        rows.append({"uri": str(p), "label": label})
+    df = DataFrame.fromRows(rows, numPartitions=3)
+    est = KerasImageFileEstimator(
+        inputCol="uri", outputCol="preds", labelCol="label",
+        model=keras.Sequential([
+            keras.Input((8, 8, 3)), layers.Rescaling(1 / 255.0),
+            layers.Flatten(), layers.Dense(2, activation="softmax")]),
+        kerasOptimizer="sgd", kerasLoss="sparse_categorical_crossentropy")
+    grid = (ParamGridBuilder()
+            .addGrid(est.kerasFitParams, [
+                {"epochs": 20, "batch_size": 8, "learning_rate": 0.05,
+                 "seed": 1},
+                {"epochs": 1, "batch_size": 8, "learning_rate": 1e-6,
+                 "seed": 1},  # deliberately under-trained
+            ]).build())
+
+    class ArgmaxEvaluator(MulticlassClassificationEvaluator):
+        def evaluate(self, dataset):
+            out = dataset.collect()
+            preds = np.array([np.argmax(r["preds"]) for r in out])
+            labels = np.array([r["label"] for r in out])
+            return float((preds == labels).mean())
+
+    cv = CrossValidator(estimator=est, estimatorParamMaps=grid,
+                        evaluator=ArgmaxEvaluator(), numFolds=2, seed=3)
+    model = cv.fit(df)
+    assert model.bestIndex == 0
+    assert model.avgMetrics[0] > model.avgMetrics[1]
