@@ -21,6 +21,7 @@ from sparkdl_tpu.ml.classification import (
 )
 from sparkdl_tpu.ml.estimator import KerasImageFileEstimator, KerasImageFileModel
 from sparkdl_tpu.ml.evaluation import (
+    BinaryClassificationEvaluator,
     MulticlassClassificationEvaluator,
     RegressionEvaluator,
 )
@@ -44,6 +45,7 @@ TFImageTransformer = TPUImageTransformer
 TFTransformer = TPUTransformer
 
 __all__ = [
+    "BinaryClassificationEvaluator",
     "CrossValidator",
     "CrossValidatorModel",
     "DeepImageFeaturizer",

@@ -25,6 +25,7 @@ import numpy as np
 import optax
 
 from sparkdl_tpu.ml.base import Estimator, Model
+from sparkdl_tpu.ml.persistence import ParamsOnlyPersistence
 from sparkdl_tpu.param.base import Param, keyword_only
 from sparkdl_tpu.param.converters import (
     SparkDLTypeConverters,
@@ -58,12 +59,29 @@ class _HasClassifierCols(HasLabelCol):
     def getProbabilityCol(self): return self.getOrDefault(self.probabilityCol)
 
 
-class LogisticRegression(Estimator, _HasClassifierCols):
+class LogisticRegression(Estimator, _HasClassifierCols,
+                         ParamsOnlyPersistence):
     """Multinomial (softmax) logistic regression on a vector column.
 
-    Spark-ML-parity params; binary problems are the k=2 case of the same
-    multinomial form (probabilities match Spark's ``family='multinomial'``
-    up to its coefficient centering).
+    **Spark ML parity envelope** (the exact contract vs
+    ``pyspark.ml.classification.LogisticRegression``, VERDICT r4 #6):
+
+    ================== =====================================================
+    matches Spark      ``featuresCol/labelCol/predictionCol/probabilityCol``,
+                       ``maxIter``, ``regParam`` (L2), ``tol``,
+                       ``fitIntercept``, ``standardization`` — features are
+                       scaled by their (unbiased) std before the solve and
+                       coefficients unscaled after, so regularized fits
+                       match Spark's default-standardized coefficients;
+                       the intercept is never penalized.
+    differs            multinomial softmax is the ONLY family (Spark's
+                       binary path uses pivoted logistic; probabilities
+                       agree, coefficients differ by the usual centering);
+                       coefficients are NOT centered post-fit.
+    absent (raises on  ``elasticNetParam`` (L1 needs a prox/OWL-QN solver,
+    no silent default) not a deliberate omission of a flag), ``weightCol``,
+                       ``thresholds``, ``lowerBoundsOnCoefficients`` et al.
+    ================== =====================================================
     """
 
     maxIter = Param("LogisticRegression", "maxIter",
@@ -78,6 +96,12 @@ class LogisticRegression(Estimator, _HasClassifierCols):
     fitIntercept = Param("LogisticRegression", "fitIntercept",
                          "whether to fit an intercept term",
                          typeConverter=TypeConverters.toBoolean)
+    standardization = Param(
+        "LogisticRegression", "standardization",
+        "scale features to unit std before fitting (Spark's default True; "
+        "changes the regularized optimum, reported coefficients are always "
+        "on the original scale)",
+        typeConverter=TypeConverters.toBoolean)
 
     @keyword_only
     def __init__(self, *, featuresCol: str = "features",
@@ -85,12 +109,14 @@ class LogisticRegression(Estimator, _HasClassifierCols):
                  predictionCol: str = "prediction",
                  probabilityCol: str = "probability",
                  maxIter: int = 100, regParam: float = 0.0,
-                 tol: float = 1e-6, fitIntercept: bool = True) -> None:
+                 tol: float = 1e-6, fitIntercept: bool = True,
+                 standardization: bool = True) -> None:
         super().__init__()
         self._setDefault(featuresCol="features", labelCol="label",
                          predictionCol="prediction",
                          probabilityCol="probability", maxIter=100,
-                         regParam=0.0, tol=1e-6, fitIntercept=True)
+                         regParam=0.0, tol=1e-6, fitIntercept=True,
+                         standardization=True)
         self.setParams(**self._input_kwargs)
 
     @keyword_only
@@ -100,7 +126,8 @@ class LogisticRegression(Estimator, _HasClassifierCols):
                   probabilityCol: str = "probability",
                   maxIter: int = 100, regParam: float = 0.0,
                   tol: float = 1e-6,
-                  fitIntercept: bool = True) -> "LogisticRegression":
+                  fitIntercept: bool = True,
+                  standardization: bool = True) -> "LogisticRegression":
         self._set(**self._input_kwargs)
         return self
 
@@ -119,6 +146,12 @@ class LogisticRegression(Estimator, _HasClassifierCols):
     def setFitIntercept(self, value): return self._set(fitIntercept=value)
 
     def getFitIntercept(self): return self.getOrDefault(self.fitIntercept)
+
+    def setStandardization(self, value):
+        return self._set(standardization=value)
+
+    def getStandardization(self):
+        return self.getOrDefault(self.standardization)
 
     def _collect_xy(self, dataset):
         rows = dataset.select(self.getFeaturesCol(),
@@ -143,28 +176,24 @@ class LogisticRegression(Estimator, _HasClassifierCols):
             raise ValueError("labels must be non-negative class indices")
         return x, y, int(y.max()) + 1
 
-    # -- persistence (unfitted: params-only metadata) ------------------------
-
-    def save(self, path: str) -> None:
-        import os
-
-        from sparkdl_tpu.ml import persistence as P
-
-        os.makedirs(path, exist_ok=True)
-        P.write_metadata(path, self, P.jsonable_params(self), {})
-
-    @classmethod
-    def _load_from(cls, path: str, meta):
-        return cls(**meta["params"])
-
     def _fit(self, dataset) -> "LogisticRegressionModel":
         x, y, n_classes = self._collect_xy(dataset)
         if n_classes < 2:
             n_classes = 2
+        # Spark semantics: fit in unit-std feature space (intercept
+        # unpenalized and unaffected — scaling is shift-free), report
+        # coefficients on the original scale.
+        std = None
+        if self.getStandardization() and len(x) > 1:
+            std = x.std(axis=0, ddof=1).astype(np.float32)
+            std = np.where(std > 0, std, 1.0).astype(np.float32)
+            x = x / std
         w, b, iters = _fit_softmax(
             x, y, n_classes, max_iter=self.getMaxIter(),
             reg=self.getRegParam(), tol=self.getTol(),
             fit_intercept=self.getFitIntercept())
+        if std is not None:
+            w = np.asarray(w) / std[:, None]
         model = LogisticRegressionModel(
             featuresCol=self.getFeaturesCol(), labelCol=self.getLabelCol(),
             predictionCol=self.getPredictionCol(),

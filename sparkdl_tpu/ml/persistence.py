@@ -48,6 +48,13 @@ _LOADABLE = {
     "sparkdl_tpu.ml.estimator.KerasImageFileModel",
     "sparkdl_tpu.ml.base.Pipeline",
     "sparkdl_tpu.ml.base.PipelineModel",
+    "sparkdl_tpu.ml.evaluation.MulticlassClassificationEvaluator",
+    "sparkdl_tpu.ml.evaluation.RegressionEvaluator",
+    "sparkdl_tpu.ml.evaluation.BinaryClassificationEvaluator",
+    "sparkdl_tpu.ml.tuning.CrossValidator",
+    "sparkdl_tpu.ml.tuning.CrossValidatorModel",
+    "sparkdl_tpu.ml.tuning.TrainValidationSplit",
+    "sparkdl_tpu.ml.tuning.TrainValidationSplitModel",
 }
 
 
@@ -214,6 +221,19 @@ class ModelFunctionPersistence:
         inst = cls(**meta["params"])
         inst._restore_model_function(mf)
         return inst
+
+
+class ParamsOnlyPersistence:
+    """save/_load_from for stages whose whole state is their params
+    (evaluators, simple unfitted estimators): metadata JSON, no artifacts."""
+
+    def save(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+        write_metadata(path, self, jsonable_params(self), {})
+
+    @classmethod
+    def _load_from(cls, path: str, meta):
+        return cls(**meta["params"])
 
 
 def save_stage_dirs(instance, stages, path: str) -> None:

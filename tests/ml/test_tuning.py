@@ -187,3 +187,214 @@ def test_cross_validator_over_keras_estimator(rng, tmp_path):
     model = cv.fit(df)
     assert model.bestIndex == 0
     assert model.avgMetrics[0] > model.avgMetrics[1]
+
+
+# -- BinaryClassificationEvaluator (VERDICT r4 #5) --------------------------
+
+def test_binary_evaluator_hand_computed():
+    from sparkdl_tpu.ml import BinaryClassificationEvaluator
+
+    # scores desc: 0.8(+), 0.6(-), 0.4(+), 0.2(-)  P=2 N=2
+    # ROC points (fpr,tpr): (0,.5) (.5,.5) (.5,1) (1,1) -> AUC = 0.75
+    # PR points (rec,prec): (0,1)^ (.5,1) (.5,.5) (1,2/3) (1,.5)
+    #   -> AUPR = 0.5 + avg(0.5, 2/3)*0.5 = 19/24
+    rows = [{"rawPrediction": s, "label": l} for s, l in
+            [(0.8, 1), (0.6, 0), (0.4, 1), (0.2, 0)]]
+    df = DataFrame.fromRows(rows)
+    ev = BinaryClassificationEvaluator()
+    assert ev.evaluate(df) == pytest.approx(0.75)
+    assert ev.isLargerBetter()
+    aupr = BinaryClassificationEvaluator(metricName="areaUnderPR").evaluate(df)
+    assert aupr == pytest.approx(19 / 24)
+
+
+def test_binary_evaluator_ties_vectors_and_edges():
+    from sparkdl_tpu.ml import BinaryClassificationEvaluator
+
+    # all-tied scores collapse to one threshold -> chance AUC 0.5
+    tied = DataFrame.fromRows(
+        [{"rawPrediction": 0.5, "label": l} for l in (1, 0, 1, 0)])
+    assert BinaryClassificationEvaluator().evaluate(tied) == \
+        pytest.approx(0.5)
+    # probability-vector column: last element is the positive class
+    vec = DataFrame.fromRows(
+        [{"probability": [1 - s, s], "label": l} for s, l in
+         [(0.9, 1), (0.8, 1), (0.2, 0), (0.1, 0)]])
+    ev = BinaryClassificationEvaluator(rawPredictionCol="probability")
+    assert ev.evaluate(vec) == pytest.approx(1.0)
+    assert BinaryClassificationEvaluator(
+        rawPredictionCol="probability",
+        metricName="areaUnderPR").evaluate(vec) == pytest.approx(1.0)
+    # single-class input is undefined
+    with pytest.raises(ValueError, match="both classes"):
+        BinaryClassificationEvaluator().evaluate(DataFrame.fromRows(
+            [{"rawPrediction": 0.5, "label": 1}]))
+    # non-binary labels rejected
+    with pytest.raises(ValueError, match="binary"):
+        BinaryClassificationEvaluator().evaluate(DataFrame.fromRows(
+            [{"rawPrediction": 0.5, "label": 2}]))
+
+
+def test_binary_evaluator_in_cross_validator(rng):
+    """CV integration: AUC-driven selection over a binary problem."""
+    from sparkdl_tpu.ml import BinaryClassificationEvaluator
+
+    x = rng.normal(size=(80, 4)).astype(np.float32)
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(int)
+    df = DataFrame.fromRows(
+        [{"features": x[i].tolist(), "label": int(y[i])} for i in range(80)],
+        numPartitions=2)
+    lr = LogisticRegression(maxIter=100)
+    grid = ParamGridBuilder().addGrid(lr.regParam, [0.0, 1000.0]).build()
+    cv = CrossValidator(
+        estimator=lr, estimatorParamMaps=grid,
+        evaluator=BinaryClassificationEvaluator(
+            rawPredictionCol="probability"),
+        numFolds=2, seed=5)
+    model = cv.fit(df)
+    assert model.bestIndex == 0
+    assert model.avgMetrics[0] > 0.9
+
+
+# -- parallelism (VERDICT r4 #4) --------------------------------------------
+
+def test_parallelism_matches_serial(blobs_df):
+    lr = LogisticRegression(maxIter=100)
+    grid = (ParamGridBuilder()
+            .addGrid(lr.regParam, [0.0, 1.0, 1000.0]).build())
+    ev = MulticlassClassificationEvaluator(metricName="accuracy")
+    serial = CrossValidator(estimator=lr, estimatorParamMaps=grid,
+                            evaluator=ev, numFolds=2, seed=4,
+                            parallelism=1).fit(blobs_df)
+    par = CrossValidator(estimator=lr, estimatorParamMaps=grid,
+                         evaluator=ev, numFolds=2, seed=4,
+                         parallelism=2).fit(blobs_df)
+    assert par.bestIndex == serial.bestIndex
+    np.testing.assert_allclose(par.avgMetrics, serial.avgMetrics,
+                               rtol=1e-6)
+
+
+def test_parallelism_overlaps_fits(blobs_df):
+    """parallelism=2 must actually drain fitMultiple concurrently: with a
+    per-fit stall (the host-side work a real fit overlaps with device
+    steps), the two fits' [enter, exit] windows must overlap in time —
+    a deterministic concurrency check, not a wall-clock race."""
+    import time
+
+    from sparkdl_tpu.ml.base import Model as BaseModel
+
+    windows = []
+
+    class _SleepModel(BaseModel):
+        def _transform(self, dataset):
+            return dataset.withColumn(
+                "prediction", lambda lab: float(lab), inputCols=["label"])
+
+    class _SleepEstimator(LogisticRegression):
+        def _fit(self, dataset):
+            enter = time.monotonic()
+            time.sleep(0.3)
+            windows.append((enter, time.monotonic()))
+            return _SleepModel()
+
+    grid = [{}, {}]  # two identical maps; only concurrency matters
+    ev = MulticlassClassificationEvaluator(metricName="accuracy")
+
+    def overlapped(parallelism):
+        windows.clear()
+        TrainValidationSplit(
+            estimator=_SleepEstimator(), estimatorParamMaps=grid,
+            evaluator=ev, trainRatio=0.7, seed=0,
+            parallelism=parallelism).fit(blobs_df)
+        # 3 fits total: the two grid maps + the final best-map refit;
+        # only the first two (the grid fits) can overlap
+        assert len(windows) == 3
+        (a0, a1), (b0, b1) = sorted(windows)[:2]
+        return b0 < a1  # second fit entered before the first exited
+
+    assert not overlapped(1)
+    assert overlapped(2)
+
+
+# -- tuning persistence (VERDICT r4 #3) -------------------------------------
+
+def test_cross_validator_roundtrip_and_refit(tmp_path, blobs_df):
+    from sparkdl_tpu.ml import load
+
+    lr = LogisticRegression(maxIter=100)
+    grid = ParamGridBuilder().addGrid(lr.regParam, [0.0, 1000.0]).build()
+    cv = CrossValidator(
+        estimator=lr, estimatorParamMaps=grid,
+        evaluator=MulticlassClassificationEvaluator(metricName="accuracy"),
+        numFolds=3, seed=1, parallelism=2)
+    cv.save(str(tmp_path / "cv"))
+    loaded = load(str(tmp_path / "cv"))
+    assert isinstance(loaded, CrossValidator)
+    assert loaded.getNumFolds() == 3
+    assert loaded.getSeed() == 1
+    assert loaded.getParallelism() == 2
+    assert isinstance(loaded.estimator, LogisticRegression)
+    assert loaded.estimator.getMaxIter() == 100
+    assert loaded.evaluator.getMetricName() == "accuracy"
+    assert [{p.name: v for p, v in m.items()}
+            for m in loaded.estimatorParamMaps] == [
+        {"regParam": 0.0}, {"regParam": 1000.0}]
+    # load-then-refit selects the same map as the original would
+    model = loaded.fit(blobs_df)
+    assert model.bestIndex == 0
+
+
+def test_cross_validator_model_roundtrip(tmp_path, blobs_df):
+    from sparkdl_tpu.ml import CrossValidatorModel, load
+
+    lr = LogisticRegression(maxIter=100)
+    grid = ParamGridBuilder().addGrid(lr.regParam, [0.0, 1000.0]).build()
+    cv = CrossValidator(
+        estimator=lr, estimatorParamMaps=grid,
+        evaluator=MulticlassClassificationEvaluator(metricName="accuracy"),
+        numFolds=2, seed=1)
+    model = cv.fit(blobs_df)
+    model.save(str(tmp_path / "cvm"))
+    loaded = load(str(tmp_path / "cvm"))
+    assert isinstance(loaded, CrossValidatorModel)
+    assert loaded.bestIndex == model.bestIndex
+    np.testing.assert_allclose(loaded.avgMetrics, model.avgMetrics)
+    # load-then-transform equals the original model's transform
+    want = model.transform(blobs_df).collect()
+    got = loaded.transform(blobs_df).collect()
+    np.testing.assert_allclose(
+        [r["prediction"] for r in got], [r["prediction"] for r in want])
+
+
+def test_train_validation_split_roundtrip(tmp_path, blobs_df):
+    from sparkdl_tpu.ml import TrainValidationSplitModel, load
+
+    lr = LogisticRegression(maxIter=100)
+    grid = ParamGridBuilder().addGrid(lr.regParam, [0.0, 1000.0]).build()
+    tvs = TrainValidationSplit(
+        estimator=lr, estimatorParamMaps=grid,
+        evaluator=MulticlassClassificationEvaluator(metricName="accuracy"),
+        trainRatio=0.7, seed=2)
+    tvs.save(str(tmp_path / "tvs"))
+    loaded = load(str(tmp_path / "tvs"))
+    assert isinstance(loaded, TrainValidationSplit)
+    assert loaded.getTrainRatio() == pytest.approx(0.7)
+    model = loaded.fit(blobs_df)
+    assert model.bestIndex == 0
+    model.save(str(tmp_path / "tvsm"))
+    reloaded = load(str(tmp_path / "tvsm"))
+    assert isinstance(reloaded, TrainValidationSplitModel)
+    np.testing.assert_allclose(reloaded.validationMetrics,
+                               model.validationMetrics)
+
+
+def test_tuning_persistence_rejects_unserializable_grid(tmp_path, blobs_df):
+    """Nested-stage param maps (params the estimator doesn't own) fail at
+    save with a clear message, not silently on load."""
+    lr = LogisticRegression()
+    other = MulticlassClassificationEvaluator()
+    bad_grid = [{other.metricName: "accuracy"}]
+    cv = CrossValidator(estimator=lr, estimatorParamMaps=bad_grid,
+                        evaluator=other, numFolds=2)
+    with pytest.raises(ValueError, match="does not own"):
+        cv.save(str(tmp_path / "bad"))
