@@ -80,6 +80,23 @@ def data_parallel_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     return make_mesh(MeshConfig(), devices)
 
 
+def host_local_mesh(mesh: Optional[Mesh]) -> Optional[Mesh]:
+    """Inference-side mesh under multi-host: per-host data parallelism.
+
+    Multi-host TRANSFORM is embarrassingly parallel (Spark's model: each
+    executor ran its partitions independently, SURVEY.md §3.1) — there is
+    no cross-host collective, so a mesh containing non-local devices is
+    replaced by a data mesh over this process's local devices. Single
+    process, None, or an already-local mesh pass through unchanged.
+    """
+    if mesh is None or jax.process_count() <= 1:
+        return mesh
+    local = set(jax.local_devices())
+    if all(d in local for d in mesh.devices.flat):
+        return mesh
+    return data_parallel_mesh(jax.local_devices())
+
+
 def batch_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
     """Shard dim 0 (batch) across ``data``, replicate the rest."""
     return NamedSharding(mesh, P(DATA_AXIS, *([None] * (ndim - 1))))

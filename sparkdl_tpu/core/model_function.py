@@ -232,9 +232,19 @@ class ModelFunction:
         def fn(vs, x):
             return apply_fn(vs, pre(x))
 
-        return ModelFunction(fn, self.variables, input_spec or self.input_spec,
-                             name=self.name,
-                             trainable_mask=self.trainable_mask)
+        out = ModelFunction(fn, self.variables,
+                            input_spec or self.input_spec, name=self.name,
+                            trainable_mask=self.trainable_mask)
+        self._propagate_float_source(out)
+        return out
+
+    def _propagate_float_source(self, wrapped: "ModelFunction") -> None:
+        """Composition wrappers must keep the pre-bf16-cast weights
+        reachable, or persistence silently falls back to the truncated
+        variables (the with_compute_dtype contract, ADVICE r4)."""
+        source = getattr(self, "float_source", None)
+        if source is not None:
+            wrapped.float_source = source
 
     def with_postprocess(self, post: Callable[[jax.Array], jax.Array]
                          ) -> "ModelFunction":
@@ -243,8 +253,11 @@ class ModelFunction:
         def fn(vs, x):
             return post(apply_fn(vs, x))
 
-        return ModelFunction(fn, self.variables, self.input_spec, name=self.name,
-                             trainable_mask=self.trainable_mask)
+        out = ModelFunction(fn, self.variables, self.input_spec,
+                            name=self.name,
+                            trainable_mask=self.trainable_mask)
+        self._propagate_float_source(out)
+        return out
 
     def with_compute_dtype(self, dtype) -> "ModelFunction":
         """Run this model in ``dtype`` (e.g. bfloat16 for MXU inference):
@@ -269,8 +282,13 @@ class ModelFunction:
             out = apply_fn(vs, jnp.asarray(x).astype(dtype))
             return jax.tree.map(lambda o: o.astype(jnp.float32), out)
 
-        return ModelFunction(fn, variables, self.input_spec, name=self.name,
-                             trainable_mask=self.trainable_mask)
+        out = ModelFunction(fn, variables, self.input_spec, name=self.name,
+                            trainable_mask=self.trainable_mask)
+        # Persistence must write the PRE-cast weights (ADVICE r4: a bf16
+        # model's msgpack artifact would otherwise store truncated values
+        # that switching back to f32 cannot recover).
+        out.float_source = self
+        return out
 
     def flattened(self) -> "ModelFunction":
         """Flatten outputs to (batch, -1) — the ``buildFlattener`` analog.

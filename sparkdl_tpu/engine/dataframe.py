@@ -113,6 +113,11 @@ class DataFrame:
         self._ops = list(ops or [])
         self._materialized: Optional[List[pa.RecordBatch]] = None
         self._lock = threading.Lock()
+        # (process_id, num_processes) when this frame is one host's
+        # round-robin partition share (processShard); propagated through
+        # lazy ops so downstream transforms don't re-shard and
+        # gatherProcesses can reassemble the original partition order.
+        self._process_shard: Optional[Tuple[int, int]] = None
 
     # -- constructors --------------------------------------------------------
 
@@ -312,8 +317,11 @@ class DataFrame:
         # Reuse already-materialized results (e.g. after cache()) so derived
         # frames don't recompute the upstream op chain.
         if self._materialized is not None and self._ops:
-            return DataFrame(self._materialized, schema, [op])
-        return DataFrame(self._partitions, schema, self._ops + [op])
+            out = DataFrame(self._materialized, schema, [op])
+        else:
+            out = DataFrame(self._partitions, schema, self._ops + [op])
+        out._process_shard = self._process_shard
+        return out
 
     def mapPartitions(self, fn: Callable[[pa.RecordBatch], pa.RecordBatch],
                       schema: Optional[pa.Schema] = None) -> "DataFrame":
@@ -582,6 +590,92 @@ class DataFrame:
         self._materialize()
         return self
 
+    # -- multi-host data plane (SURVEY.md §2.4/§2.5) -------------------------
+
+    def processShard(self, process_id: Optional[int] = None,
+                     num_processes: Optional[int] = None) -> "DataFrame":
+        """This process's round-robin share of the partitions, lazily.
+
+        The transform-side analog of ``streamPartitions(process_id=...)``
+        (Spark assigned partitions to executors for exactly this path,
+        SURVEY.md §3.1): host ``p`` keeps partitions ``p, p+n, p+2n, …``;
+        the op chain (decode, model apply) then only ever runs on the
+        local share. Defaults come from the jax process group. Idempotent:
+        an already-sharded frame (or any lazy derivative of one) returns
+        itself, so chained transformers never double-shard.
+        """
+        if num_processes is None or process_id is None:
+            import jax
+
+            num_processes = (jax.process_count() if num_processes is None
+                             else num_processes)
+            process_id = (jax.process_index() if process_id is None
+                          else process_id)
+        if not 0 <= process_id < max(1, num_processes):
+            # validate BEFORE the no-op returns: a bad id on a
+            # single-process run should fail here, not first on the
+            # multi-host deployment
+            raise ValueError(
+                f"process_id must be in [0, {num_processes}), got "
+                f"{process_id}")
+        if num_processes <= 1 or self._process_shard is not None:
+            return self
+        out = DataFrame(self._partitions[process_id::num_processes],
+                        self._schema, self._ops)
+        if self._materialized is not None:
+            out._materialized = self._materialized[process_id::num_processes]
+        out._process_shard = (process_id, num_processes)
+        return out
+
+    def gatherProcesses(self) -> "DataFrame":
+        """Allgather every host's shard into the FULL frame on all hosts.
+
+        The opt-in assembly step after a multi-host transform (per-host
+        output stays host-local by default, mirroring multi-host ``fit``):
+        each host materializes its local partitions, ships them as Arrow
+        IPC bytes through a jax process allgather, and every host
+        reassembles the partitions in the ORIGINAL pre-shard order — so
+        ``shard-transform-gather`` row order equals the single-process
+        transform's. Requires shard provenance: call it on the (possibly
+        lazily transformed) frame produced by :meth:`processShard`.
+        """
+        import jax
+
+        if jax.process_count() <= 1:
+            return self
+        if self._process_shard is None:
+            raise ValueError(
+                "gatherProcesses needs shard provenance: call it on the "
+                "frame produced by processShard (or a lazy transform of "
+                "it) — materializing ops like repartition/union drop it")
+        process_id, num_processes = self._process_shard
+        if (num_processes != jax.process_count()
+                or process_id != jax.process_index()):
+            # the allgather below has exactly process_count participants;
+            # a shard cut for a different topology would mis-index it
+            raise ValueError(
+                f"shard provenance (process {process_id} of "
+                f"{num_processes}) does not match the live process group "
+                f"({jax.process_index()} of {jax.process_count()}); "
+                "gatherProcesses only reassembles shards cut for this "
+                "group")
+        batches = self._materialize()
+        payload = _serialize_batches(batches, self._schema)
+        from jax.experimental import multihost_utils
+
+        data = np.frombuffer(payload, dtype=np.uint8)
+        lengths = multihost_utils.process_allgather(
+            np.asarray([len(data)], dtype=np.int64))
+        max_len = int(lengths.max())
+        padded = np.zeros(max_len, dtype=np.uint8)
+        padded[:len(data)] = data
+        gathered = multihost_utils.process_allgather(padded)
+        per_host = [
+            _deserialize_batches(gathered[p, :int(lengths[p])].tobytes())
+            for p in range(num_processes)]
+        parts, schema = _reinterleave_shards(per_host, self._schema)
+        return DataFrame(parts, schema)
+
 
 class GroupedData:
     """``df.groupBy(cols)`` result: Spark-shaped aggregations lowered onto
@@ -596,15 +690,19 @@ class GroupedData:
     def count(self) -> "DataFrame":
         grouped = self._df.toArrow().group_by(self._cols).aggregate(
             [([], "count_all")])
-        return DataFrame.fromArrow(
-            grouped.rename_columns(self._cols + ["count"]))
+        # Rename by the grouped table's ACTUAL column names — pyarrow's
+        # key/aggregate column order has differed across releases and
+        # pyproject leaves pyarrow unpinned (ADVICE r4).
+        return DataFrame.fromArrow(grouped.rename_columns(
+            ["count" if n == "count_all" else n
+             for n in grouped.column_names]))
 
     def agg(self, exprs: Dict[str, str]) -> "DataFrame":
         """``{"column": "sum"|"mean"|"avg"|"min"|"max"|"count"}`` →
         one row per group with ``<agg>(<column>)`` result columns
         (Spark's dict-form ``agg``)."""
         aggs = []
-        names = []
+        rename = {}
         for col, fn in exprs.items():
             fn = fn.lower()
             if fn not in self._AGGS:
@@ -615,16 +713,71 @@ class GroupedData:
                 raise KeyError(f"No such column: {col!r}")
             arrow_fn = {"avg": "mean"}.get(fn, fn)
             aggs.append((col, arrow_fn))
-            names.append(f"{fn}({col})")
+            rename[f"{col}_{arrow_fn}"] = f"{fn}({col})"
         grouped = self._df.toArrow().group_by(self._cols).aggregate(aggs)
-        return DataFrame.fromArrow(
-            grouped.rename_columns(self._cols + names))
+        # Map pyarrow's deterministic result names ("<col>_<fn>") to
+        # Spark's "<fn>(<col>)" by NAME, not position (ADVICE r4: older
+        # pyarrow put aggregates before keys, silently mislabeling both).
+        return DataFrame.fromArrow(grouped.rename_columns(
+            [rename.get(n, n) for n in grouped.column_names]))
 
     def mean(self, *cols: str) -> "DataFrame":
         return self.agg({c: "mean" for c in cols})
 
     def sum(self, *cols: str) -> "DataFrame":
         return self.agg({c: "sum" for c in cols})
+
+
+# ---------------------------------------------------------------------------
+# Multi-host gather helpers
+# ---------------------------------------------------------------------------
+
+def _serialize_batches(batches: Sequence[pa.RecordBatch],
+                       fallback_schema: pa.Schema) -> bytes:
+    """Partition batches → one Arrow IPC stream (batch == partition).
+
+    Batches are cast to their permissively-unified schema first: ops
+    without an explicit outputType only learn types at materialization,
+    so sibling partitions can disagree (e.g. null vs float list).
+    """
+    import io
+
+    if batches:
+        schema = pa.unify_schemas([b.schema for b in batches],
+                                  promote_options="permissive")
+        batches = [b.cast(schema) for b in batches]
+    else:
+        schema = fallback_schema
+    sink = io.BytesIO()
+    with pa.ipc.new_stream(sink, schema) as writer:
+        for b in batches:
+            writer.write_batch(b)
+    return sink.getvalue()
+
+
+def _deserialize_batches(payload: bytes) -> List[pa.RecordBatch]:
+    with pa.ipc.open_stream(payload) as reader:
+        return list(reader)
+
+
+def _reinterleave_shards(per_host: List[List[pa.RecordBatch]],
+                         fallback_schema: pa.Schema
+                         ) -> Tuple[List[pa.RecordBatch], pa.Schema]:
+    """Invert round-robin sharding: global partition ``g`` was computed by
+    host ``g % n`` at local position ``g // n``. Host schemas are unified
+    permissively (hosts infer types independently)."""
+    n = len(per_host)
+    all_batches = [b for host in per_host for b in host]
+    if not all_batches:
+        return [], fallback_schema
+    schema = pa.unify_schemas([b.schema for b in all_batches],
+                              promote_options="permissive")
+    parts: List[pa.RecordBatch] = []
+    for g in range(n * max(len(h) for h in per_host)):
+        host, pos = g % n, g // n
+        if pos < len(per_host[host]):
+            parts.append(per_host[host][pos].cast(schema))
+    return parts, schema
 
 
 # ---------------------------------------------------------------------------

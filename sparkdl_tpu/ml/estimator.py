@@ -302,12 +302,11 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
                     f"multi-host fit needs the mesh data axis "
                     f"({multiple}) to be a multiple of the process count "
                     f"({num_proc})")
-            if (fit_params.get("validation_data") is not None
-                    or fit_params.get("validation_split")):
-                raise ValueError(
-                    "validation is not supported under multi-host fit "
-                    "(evaluation stages host-local arrays); validate the "
-                    "fitted model afterwards")
+            # validation_data works multi-host: state is replicated, so
+            # Trainer.evaluate pulls it host-local and every process
+            # computes the exact single-process metrics (r5; the
+            # validation_split raise below still applies — it needs the
+            # collected path on any topology).
             # every host contributes an equal local slice of each global
             # batch
             batch_size //= num_proc

@@ -105,11 +105,18 @@ class TPUImageTransformer(Transformer, HasInputCol, HasOutputCol,
         model = self.getModelFunction()
         if model is None:
             raise ValueError("modelFunction must be set")
+        # Multi-host data-parallel inference (SURVEY.md §2.4 row 1): each
+        # process transforms only its round-robin partition share; no-op
+        # single-process, idempotent across chained transformers. Assembly
+        # is opt-in via DataFrame.gatherProcesses (docs/DISTRIBUTED.md).
+        dataset = dataset.processShard()
         input_col = self.getInputCol()
         output_col = self.getOutputCol()
         mode = self.getOutputMode()
         batch_size = self.getBatchSize()
-        mesh = self.resolveMesh()
+        from sparkdl_tpu.core.mesh import host_local_mesh
+
+        mesh = host_local_mesh(self.resolveMesh())
         target_size = self._target_size(model)
         run = model.flattened() if mode == "vector" else model
         if input_col not in dataset.columns:

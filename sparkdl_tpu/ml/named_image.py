@@ -143,7 +143,11 @@ class _NamedImageTransformer(Transformer, HasInputCol, HasOutputCol,
                 _KerasPayload(weights), path)
         else:
             mf = self._model_function(self._persist_kind)
-            artifacts["weights"] = P.save_weights_msgpack(mf.variables, path)
+            # float_source: the pre-bf16-cast model (full-precision
+            # weights); the dtype cast re-applies at load (ADVICE r4)
+            source = getattr(mf, "float_source", mf)
+            artifacts["weights"] = P.save_weights_msgpack(source.variables,
+                                                          path)
         P.write_metadata(path, self, params, artifacts)
 
     @classmethod

@@ -119,12 +119,19 @@ class TPUTransformer(Transformer, HasInputCol, HasOutputCol,
         model = self.getModelFunction()
         if model is None:
             raise ValueError("modelFunction must be set")
+        # Multi-host data-parallel inference (SURVEY.md §2.4 row 1): each
+        # process transforms only its round-robin partition share; no-op
+        # single-process, idempotent across chained transformers. Assembly
+        # is opt-in via DataFrame.gatherProcesses (docs/DISTRIBUTED.md).
+        dataset = dataset.processShard()
         if isinstance(model.input_spec, dict) or self.getInputMapping():
             return self._transform_multi(dataset, model)
         input_col = self.getInputCol()
         output_col = self.getOutputCol()
         batch_size = self.getBatchSize()
-        mesh = self.resolveMesh()
+        from sparkdl_tpu.core.mesh import host_local_mesh
+
+        mesh = host_local_mesh(self.resolveMesh())
         element_shape = model.input_spec.element_shape
         if input_col not in dataset.columns:
             raise KeyError(f"No such column: {input_col!r}")
@@ -168,7 +175,9 @@ class TPUTransformer(Transformer, HasInputCol, HasOutputCol,
             if col not in dataset.columns:
                 raise KeyError(f"No such column: {col!r}")
         batch_size = self.getBatchSize()
-        mesh = self.resolveMesh()
+        from sparkdl_tpu.core.mesh import host_local_mesh
+
+        mesh = host_local_mesh(self.resolveMesh())
         out_cols = list(out_map.items())  # [(output-name, column)]
 
         def apply_partition(batch: pa.RecordBatch) -> pa.RecordBatch:

@@ -111,9 +111,12 @@ class _ValidatorParams(Params):
         its model on ``val``; ``parallelism`` worker threads drain the
         thread-safe iterator concurrently (scores land by index, so the
         result is identical to serial draining)."""
+        import jax
+
         maps = self.estimatorParamMaps
         scores: List[Optional[float]] = [None] * len(maps)
         models = self.estimator.fitMultiple(train, maps)
+        multihost = jax.process_count() > 1
 
         def drain() -> None:
             while True:
@@ -121,10 +124,20 @@ class _ValidatorParams(Params):
                     index, model = next(models)
                 except StopIteration:
                     return
-                scores[index] = float(
-                    self.evaluator.evaluate(model.transform(val)))
+                out = model.transform(val)
+                if multihost:
+                    # transform auto-shards per process; every host must
+                    # score the FULL validation output or _best_index can
+                    # diverge across hosts (and with it the refit)
+                    out = out.gatherProcesses()
+                scores[index] = float(self.evaluator.evaluate(out))
 
         n_threads = min(max(1, self.getParallelism()), len(maps))
+        if multihost:
+            # collectives (gather, multi-host fit steps) must issue in
+            # the same order on every process; concurrent draining would
+            # interleave them nondeterministically
+            n_threads = 1
         if n_threads == 1:
             drain()
         else:
@@ -138,6 +151,18 @@ class _ValidatorParams(Params):
         arr = np.asarray(metrics)
         return int(np.argmax(arr) if self.evaluator.isLargerBetter()
                    else np.argmin(arr))
+
+    def _refit(self, dataset, best: int) -> Model:
+        """Refit the winning map THROUGH fitMultiple so the final model
+        trains under the same regime as the fold fits (ADVICE r4: a bare
+        estimator.fit defaults streaming=True while fitMultiple's cache
+        path defaults collected — selection and refit would silently use
+        different shuffle semantics)."""
+        model: Optional[Model] = None
+        for _, fitted in self.estimator.fitMultiple(
+                dataset, [self.estimatorParamMaps[best]]):
+            model = fitted
+        return model
 
     # -- persistence (Spark MLWritable parity for the tuning layer) ----------
 
@@ -252,8 +277,7 @@ class CrossValidator(Estimator, _ValidatorParams):
             totals += np.asarray(self._fit_and_score(train, folds[i]))
         avg = (totals / k).tolist()
         best = self._best_index(avg)
-        best_model = self.estimator.fit(dataset,
-                                        self.estimatorParamMaps[best])
+        best_model = self._refit(dataset, best)
         model = CrossValidatorModel(best_model, avg, best)
         model._set_parent(self)
         return model
@@ -314,8 +338,7 @@ class TrainValidationSplit(Estimator, _ValidatorParams):
                                          seed=self.getSeed())
         metrics = self._fit_and_score(train, val)
         best = self._best_index(metrics)
-        best_model = self.estimator.fit(dataset,
-                                        self.estimatorParamMaps[best])
+        best_model = self._refit(dataset, best)
         model = TrainValidationSplitModel(best_model, list(metrics), best)
         model._set_parent(self)
         return model

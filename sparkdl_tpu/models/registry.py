@@ -196,6 +196,18 @@ def _build_ingested(name: str, weights, include_top: bool,
                 "object, an .h5/.keras file, or a msgpack file saved by "
                 "this framework")
         if isinstance(weights, str) and weights not in ("random",):
+            # Opening unknown strings blind surfaced typos (or the
+            # upstream-conventional 'imagenet' marker, which needs a
+            # network this env doesn't have) as raw flax/IO errors
+            # (ADVICE r4) — state the accepted values instead.
+            if not os.path.exists(weights):
+                raise ValueError(
+                    f"weights={weights!r} for ingested model {name!r} is "
+                    "neither a supported marker nor an existing file. "
+                    "Accepted: 'random' (fresh keras init), a Keras model "
+                    "object, an .h5/.keras model file, or a msgpack "
+                    "weights file saved by this framework ('imagenet' "
+                    "downloads are not available without network access)")
             msgpack_path = weights
         ctor = _resolve_keras_ctor(name)
         kwargs = {"weights": None, "input_shape": (h, w, 3)}

@@ -55,7 +55,22 @@ class CheckpointManager:
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
             if hasattr(x, "shape") and hasattr(x, "dtype") else x,
             state_template)
-        return self._mgr.restore(step, args=ocp.args.StandardRestore(template))
+        try:
+            return self._mgr.restore(step,
+                                     args=ocp.args.StandardRestore(template))
+        except (ValueError, KeyError) as e:
+            # Most common cause: the checkpoint predates a change in the
+            # train-state pytree — e.g. named optimizers now wrap in
+            # optax.inject_hyperparams (r4), which changed the opt_state
+            # structure — so a bare Orbax structure-mismatch would be
+            # undebuggable (ADVICE r4).
+            raise ValueError(
+                f"Checkpoint under {self.directory} (step {step}) does not "
+                "match the current train-state structure. It was likely "
+                "written by an earlier version with a different "
+                "optimizer-state format; delete the checkpoint_dir to "
+                f"restart training from scratch. Original error: {e}"
+            ) from e
 
     def wait_until_finished(self) -> None:
         self._mgr.wait_until_finished()

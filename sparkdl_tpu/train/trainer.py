@@ -345,14 +345,21 @@ class Trainer:
                  ) -> Dict[str, float]:
         """Mean loss/accuracy over a batch stream (keras ``evaluate``).
 
-        Single-controller only: each process evaluates with its own
-        host-local arrays. Multi-host fits must not call this (the
-        estimator rejects validation under multi-host up front).
+        Multi-host (VERDICT r4 #7): training state is replicated, so every
+        host holds a full copy — pull it host-local and evaluate the
+        (host-identical) validation batches as a purely LOCAL computation.
+        Every process reports metrics EXACTLY equal to a single-process
+        evaluation; no collectives, no divisibility constraints on the
+        validation batch size.
         """
         if jax.process_count() > 1:
-            raise NotImplementedError(
-                "Trainer.evaluate stages host-local arrays and cannot run "
-                "under a multi-host process group")
+            try:
+                state = jax.tree.map(
+                    lambda a: np.asarray(jax.device_get(a)), state)
+            except RuntimeError as e:
+                raise NotImplementedError(
+                    "multi-host evaluate requires fully-replicated train "
+                    f"state (every host must hold a full copy): {e}") from e
         eval_step = self.make_eval_metrics_step()
         totals: Dict[str, float] = {}
         n = 0

@@ -289,3 +289,46 @@ def test_group_by_convenience_mean_sum():
     assert m == {1: 3.0, 2: 10.0}
     s = {r["g"]: r["sum(v)"] for r in df.groupBy("g").sum("v").collect()}
     assert s == {1: 6.0, 2: 10.0}
+
+
+# -- multi-host transform primitives (VERDICT r4 #1) ------------------------
+
+def test_process_shard_partitions_and_idempotence():
+    import pyarrow as pa
+
+    from sparkdl_tpu.engine.dataframe import DataFrame
+
+    df = DataFrame.fromRows([{"i": i} for i in range(12)], numPartitions=4)
+    shards = [df.processShard(process_id=p, num_processes=3)
+              for p in range(3)]
+    seen = [set(r["i"] for r in s.collect()) for s in shards]
+    assert set().union(*seen) == set(range(12))
+    assert sum(len(s) for s in seen) == 12  # disjoint + exhaustive
+    # lazy ops on a shard keep provenance and don't re-shard
+    derived = shards[0].select("i")
+    assert derived._process_shard == (0, 3)
+    assert derived.processShard(process_id=1, num_processes=3) is derived
+    # single process is a no-op
+    assert df.processShard(process_id=0, num_processes=1) is df
+    with pytest.raises(ValueError, match="process_id"):
+        df.processShard(process_id=3, num_processes=3)
+
+
+def test_reinterleave_shards_restores_order():
+    import pyarrow as pa
+
+    from sparkdl_tpu.engine.dataframe import (DataFrame,
+                                              _deserialize_batches,
+                                              _reinterleave_shards,
+                                              _serialize_batches)
+
+    df = DataFrame.fromRows([{"i": i} for i in range(10)], numPartitions=5)
+    n = 2
+    per_host = []
+    for p in range(n):
+        shard = df.processShard(process_id=p, num_processes=n)
+        payload = _serialize_batches(shard._materialize(), shard.schema)
+        per_host.append(_deserialize_batches(payload))
+    parts, schema = _reinterleave_shards(per_host, df.schema)
+    rebuilt = DataFrame(parts, schema)
+    assert [r["i"] for r in rebuilt.collect()] == list(range(10))

@@ -215,3 +215,48 @@ def test_keras_reference_covers_ingested_names():
     assert ctor.__name__ == "DenseNet121"
     with pytest.raises(ValueError, match="counterpart"):
         registry._resolve_keras_ctor("NoSuchNet")
+
+
+def test_ingested_bf16_saves_full_precision_weights(rng, tmp_path):
+    """ADVICE r4: a dtype=bfloat16 ingested stage must persist the
+    PRE-cast f32 weights, so reloading the artifact as float32 recovers
+    full precision (not bf16-truncated values)."""
+    pytest.importorskip("keras")
+    import flax.serialization as fser
+    import jax
+    import jax.numpy as jnp
+
+    from sparkdl_tpu.ml import load
+
+    t = DeepImageFeaturizer(inputCol="image", outputCol="f",
+                            modelName="MobileNetV3Small", batchSize=2,
+                            dtype=jnp.bfloat16)
+    mf = t._model_function("featurize")
+    assert hasattr(mf, "float_source")  # survives the preprocess wrap
+    t.save(str(tmp_path / "bf16"))
+    # the artifact holds float32 leaves, not bf16-truncated ones
+    with open(tmp_path / "bf16" / "weights.msgpack", "rb") as f:
+        raw = fser.msgpack_restore(f.read())
+    float_leaves = [l for l in jax.tree.leaves(raw)
+                    if hasattr(l, "dtype") and l.dtype.kind == "f"]
+    assert float_leaves and all(
+        l.dtype == np.float32 for l in float_leaves), sorted(
+        {str(l.dtype) for l in float_leaves})
+    # and the saved values equal the pre-cast source exactly
+    src = jax.device_get(mf.float_source.variables)
+    got_leaves = jax.tree.leaves(raw)
+    want_leaves = jax.tree.leaves(src)
+    assert len(got_leaves) == len(want_leaves)
+    for g, w in zip(got_leaves, want_leaves):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+    # reloaded at f32, the stage serves full-precision outputs
+    t32 = load(str(tmp_path / "bf16"))
+    t32.setDtype(None)
+    rows = [{"image": imageIO.imageArrayToStruct(
+        rng.integers(0, 255, size=(64, 64, 3), dtype=np.uint8),
+        origin="0")}]
+    df = DataFrame.fromRows(
+        rows, schema=pa.schema([pa.field("image", imageIO.imageSchema)]),
+        numPartitions=1)
+    out = t32.transform(df).collect()
+    assert np.asarray(out[0]["f"], np.float32).shape == (576,)

@@ -50,13 +50,19 @@ def build_estimator(data_dir: str, mesh) -> "KerasImageFileEstimator":
         manifest = json.load(f)
     df = DataFrame.fromRows(manifest["rows"],
                             numPartitions=NUM_PARTITIONS)
+    # deterministic epoch-end validation set (VERDICT r4 #7): identical
+    # arrays on every process; history must equal the single-process fit's
+    vrng = np.random.default_rng(3)
+    vx = vrng.integers(0, 255, size=(6, 8, 8, 3)).astype(np.float32)
+    vy = np.eye(2, dtype=np.float32)[vrng.integers(0, 2, 6)]
     est = KerasImageFileEstimator(
         inputCol="uri", outputCol="preds", labelCol="label",
         modelFile=manifest["model_file"], kerasOptimizer="sgd",
         kerasLoss="categorical_crossentropy", mesh=mesh,
         kerasFitParams={"epochs": 2, "batch_size": GLOBAL_BATCH,
                         "shuffle": False, "streaming": True,
-                        "learning_rate": 0.05})
+                        "learning_rate": 0.05,
+                        "validation_data": (vx, vy)})
     return est, df
 
 
@@ -75,6 +81,9 @@ def main(data_dir: str, out_dir: str) -> None:
     if jax.process_index() == 0:
         np.save(os.path.join(out_dir, "multihost_estimator_params.npy"),
                 flat_params(model))
+        with open(os.path.join(out_dir,
+                               "multihost_estimator_history.json"), "w") as f:
+            json.dump(model.history["epochs"], f)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,95 @@
+"""Worker for the 2-process multi-host ``DeepImageFeaturizer.transform(df)``
+test (VERDICT r4 #1 / SURVEY.md §2.4 row 1, §3.1 — the flagship featurize
+path the reference scaled horizontally).
+
+Each of two processes owns 4 virtual CPU devices, joins the process group
+via the SPARKDL_* env triple, and calls the PUBLIC ML API:
+``featurizer.transform(df)``. The transformer must shard the frame
+per-process (each host decodes + featurizes only its round-robin partition
+share — asserted via the local shard's row count), and
+``gatherProcesses()`` must reassemble the FULL output in original row
+order on every host; process 0 writes the gathered features for
+comparison with a single-process transform of the same DataFrame.
+
+Usage: python _multihost_transform_worker.py <out_dir>
+"""
+
+import os
+import sys
+
+if __name__ == "__main__":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=4")
+
+import jax  # noqa: E402
+
+if __name__ == "__main__":
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from sparkdl_tpu.engine.dataframe import DataFrame  # noqa: E402
+from sparkdl_tpu.image import imageIO  # noqa: E402
+from sparkdl_tpu.ml import DeepImageFeaturizer  # noqa: E402
+from sparkdl_tpu.train.runner import maybe_initialize_distributed  # noqa: E402
+
+NUM_ROWS = 16
+NUM_PARTITIONS = 4
+
+
+def build_frame() -> "DataFrame":
+    """Deterministic image-struct frame, identical on every process."""
+    import pyarrow as pa
+
+    rng = np.random.default_rng(7)
+    rows = []
+    for i in range(NUM_ROWS):
+        arr = rng.integers(0, 255, size=(32, 32, 3), dtype=np.uint8)
+        rows.append({"image": imageIO.imageArrayToStruct(arr, origin=str(i)),
+                     "idx": i})
+    schema = pa.schema([pa.field("image", imageIO.imageSchema),
+                        pa.field("idx", pa.int64())])
+    return DataFrame.fromRows(rows, schema=schema,
+                              numPartitions=NUM_PARTITIONS)
+
+
+def build_featurizer() -> "DeepImageFeaturizer":
+    # TestNet: seeded Flax init — identical weights on every process
+    return DeepImageFeaturizer(inputCol="image", outputCol="features",
+                               modelName="TestNet", batchSize=8)
+
+
+def features_matrix(collected) -> np.ndarray:
+    return np.stack([np.asarray(r["features"], np.float32)
+                     for r in collected])
+
+
+def main(out_dir: str) -> None:
+    assert maybe_initialize_distributed(), "SPARKDL_* env triple not set"
+    assert jax.process_count() == 2, jax.process_count()
+    df = build_frame()
+    out = build_featurizer().transform(df)
+    # the transform output is this host's shard: half the partitions
+    local = out.collect()
+    assert len(local) == NUM_ROWS // 2, (jax.process_index(), len(local))
+    # local shard holds exactly the round-robin partition share
+    want_idx = []
+    per_part = NUM_ROWS // NUM_PARTITIONS
+    for p in range(jax.process_index(), NUM_PARTITIONS, 2):
+        want_idx.extend(range(p * per_part, (p + 1) * per_part))
+    assert [r["idx"] for r in local] == want_idx, (jax.process_index(),
+                                                  [r["idx"] for r in local])
+    # opt-in gather: every host reassembles the FULL frame in original order
+    full = out.gatherProcesses().collect()
+    assert [r["idx"] for r in full] == list(range(NUM_ROWS))
+    if jax.process_index() == 0:
+        np.save(os.path.join(out_dir, "multihost_transform_features.npy"),
+                features_matrix(full))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

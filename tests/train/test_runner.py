@@ -187,6 +187,8 @@ def test_two_process_estimator_fit_matches_single(tmp_path):
     for p, out in zip(procs, outs):
         assert p.returncode == 0, out.decode(errors="replace")[-3000:]
     got = np.load(tmp_path / "multihost_estimator_params.npy")
+    with open(tmp_path / "multihost_estimator_history.json") as f:
+        got_history = json.load(f)
 
     # single-process reference: same estimator, same DataFrame, 8 local
     # devices (this pytest process), streaming fit
@@ -197,5 +199,60 @@ def test_two_process_estimator_fit_matches_single(tmp_path):
         sys.path.pop(0)
     mesh = make_mesh(MeshConfig(data=8))
     est, df = w.build_estimator(str(tmp_path), mesh)
-    want = w.flat_params(est.fit(df))
+    model = est.fit(df)
+    want = w.flat_params(model)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    # epoch-end validation under multi-host (VERDICT r4 #7): history equals
+    # the single-process fit's
+    want_history = model.history["epochs"]
+    assert len(got_history) == len(want_history) == 2
+    for g, s in zip(got_history, want_history):
+        assert g["epoch"] == s["epoch"]
+        for key in ("val_loss", "val_accuracy"):
+            assert key in g and key in s
+            np.testing.assert_allclose(g[key], s[key], rtol=1e-5,
+                                       atol=1e-6)
+
+
+def test_two_process_transform_matches_single(tmp_path):
+    """Multi-host DP INFERENCE through the public ML API (VERDICT r4 #1):
+    each process featurizes only its round-robin partition share (asserted
+    inside the worker), gatherProcesses reassembles the full frame in
+    original order, and the gathered features equal a single-process
+    transform of the same DataFrame."""
+    import socket
+    import subprocess
+    import sys
+
+    worker = os.path.join(os.path.dirname(__file__),
+                          "_multihost_transform_worker.py")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.update({
+            "SPARKDL_COORDINATOR": f"127.0.0.1:{port}",
+            "SPARKDL_NUM_PROCESSES": "2",
+            "SPARKDL_PROCESS_ID": str(pid),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, worker, str(tmp_path)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    outs = [p.communicate(timeout=240)[0] for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out.decode(errors="replace")[-3000:]
+    got = np.load(tmp_path / "multihost_transform_features.npy")
+
+    # single-process reference: same frame, same featurizer (processShard
+    # is a no-op at process_count == 1)
+    sys.path.insert(0, os.path.dirname(worker))
+    try:
+        import _multihost_transform_worker as w
+    finally:
+        sys.path.pop(0)
+    out = w.build_featurizer().transform(w.build_frame()).collect()
+    want = w.features_matrix(out)
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
