@@ -46,6 +46,8 @@ import numpy as np
 HEADLINE_BATCH = 128
 FLOPS_PER_IMG_INCEPTION = 5.7e9   # fwd, 2*MACs, 299x299
 FLOPS_PER_IMG_RESNET50 = 7.75e9   # fwd, 2*MACs, 224x224
+FLOPS_PER_IMG_DENSENET121 = 5.7e9   # fwd, 2*MACs, 224x224
+FLOPS_PER_IMG_EFFNETB0 = 0.78e9     # fwd, 2*MACs, 224x224
 PEAK_TFLOPS_BF16 = 197            # v5e
 
 # Metrics where a SMALLER value is the improvement (step times).
@@ -426,6 +428,20 @@ def main():
                 "ResNet50", (224, 224), FLOPS_PER_IMG_RESNET50)
             emit("images/sec/chip (ResNet50 featurize)", rips,
                  "images/sec/chip", mfu=round(rmfu, 4), runs=rruns)
+
+            # ingestion-backed zoo coverage (VERDICT r4 #9): driver-capture
+            # the generic keras layer-DAG walker's program so regressions
+            # in that path surface as vs_baseline drops, not just
+            # builder-local notes. Two representatives: the concat-bound
+            # (DenseNet121) and the dw/SE conv-bound (EfficientNetB0)
+            # regimes measured in docs/PERF.md.
+            for name, flops in (("DenseNet121", FLOPS_PER_IMG_DENSENET121),
+                                ("EfficientNetB0", FLOPS_PER_IMG_EFFNETB0)):
+                iips, isp, imfu, iruns = bench_device_featurize(
+                    name, (224, 224), flops)
+                emit(f"images/sec/chip ({name} featurize, ingested)", iips,
+                     "images/sec/chip", spread=round(isp, 4),
+                     mfu=round(imfu, 4), runs=iruns)
 
             # re-emit the headline as the final line for tail parsers
             print(json.dumps(headline), flush=True)

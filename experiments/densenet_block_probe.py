@@ -19,13 +19,19 @@ C) **buffer** — preallocate the block's final width once and
 Timing: self-chained iterations inside one jit (in-program slope method;
 cross-dispatch timing is unreliable over the remote PJRT tunnel).
 
-Result (2026-07-30, 1x v5e chip, bf16, b128): A 6.61 ms, B 5.85 ms
-(0.88x A — the segment 1x1 convs are too thin to win back the copies),
-C 6.73 ms (dynamic_update_slice materializes the same traffic). The
-concat program is within ~13% of the best alternative formulation —
-the O(L^2) re-reads are inherent to the architecture, and XLA's concat
-already runs near the measured small-buffer HBM ceiling. See
-docs/PERF.md "DenseNet121" for the full attribution.
+Result (2026-07-30, 1x v5e chip, bf16, b128):
+
+    concat (walker)       4.09 ms/block
+    segment-sum           4.78 ms/block   (1.17x SLOWER than concat)
+    buffer+dus           13.94 ms/block   (3.4x slower; strided channel
+                                           slices force layout copies)
+
+The walker's concat program WINS: splitting the 1x1 convs into
+per-segment convs loses more MXU efficiency (C_in=32 slivers) than the
+eliminated concat writes save, and the preallocated-buffer form pays
+layout copies on every strided channel slice. DenseNet's O(L^2)
+re-reads are architectural; XLA's concat is already the best available
+formulation. See docs/PERF.md "DenseNet121" for the ceiling write-up.
 """
 
 import sys

@@ -23,6 +23,8 @@ _LAZY_EXPORTS = {
     "readImagesWithCustomFn": ("sparkdl_tpu.image", "readImagesWithCustomFn"),
     # engine
     "DataFrame": ("sparkdl_tpu.engine", "DataFrame"),
+    "sql": ("sparkdl_tpu.engine", "sql"),
+    "table": ("sparkdl_tpu.engine", "table"),
     # ml pipeline surface (reference __all__ parity)
     "Pipeline": ("sparkdl_tpu.ml", "Pipeline"),
     "PipelineModel": ("sparkdl_tpu.ml", "PipelineModel"),

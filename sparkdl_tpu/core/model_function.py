@@ -286,8 +286,9 @@ class ModelFunction:
                             trainable_mask=self.trainable_mask)
         # Persistence must write the PRE-cast weights (ADVICE r4: a bf16
         # model's msgpack artifact would otherwise store truncated values
-        # that switching back to f32 cannot recover).
-        out.float_source = self
+        # that switching back to f32 cannot recover). Chain through an
+        # existing source so re-casting a cast model keeps the original.
+        out.float_source = getattr(self, "float_source", self)
         return out
 
     def flattened(self) -> "ModelFunction":

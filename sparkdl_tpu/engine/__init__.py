@@ -4,6 +4,12 @@ Replaces the reference's Spark JVM data plane (SURVEY.md §1 L1, §2.3) with
 an Arrow-native engine sized to this framework's workloads.
 """
 
-from sparkdl_tpu.engine.dataframe import DataFrame, EngineConfig, TaskFailure
+from sparkdl_tpu.engine.dataframe import (
+    DataFrame,
+    EngineConfig,
+    TaskFailure,
+    sql,
+    table,
+)
 
-__all__ = ["DataFrame", "EngineConfig", "TaskFailure"]
+__all__ = ["DataFrame", "EngineConfig", "TaskFailure", "sql", "table"]

@@ -488,8 +488,44 @@ class DataFrame:
                               out_type if out_type is not None else pa.null())
         return self._with_op(op, schema)
 
+    def where(self, expr: str) -> "DataFrame":
+        """SQL row filter: ``df.where("label = 1 AND score > 0.5")``.
+
+        The filter side of the serving surface (SURVEY.md §3.4):
+        comparisons (``= != <> < <= > >=``), ``AND/OR/NOT``, grouping
+        parens and ``IS [NOT] NULL`` over columns and literals, with SQL
+        null semantics (a comparison against NULL is not-true — the row
+        drops). Grammar in ``engine/sql_expr.py``; UDF calls belong in
+        ``selectExpr``, not here.
+        """
+        from sparkdl_tpu.engine import sql_expr
+
+        node = sql_expr.parse_bool(expr)
+        cols = sql_expr.bool_columns(node)
+        for c in cols:
+            if c not in self.columns:
+                raise KeyError(f"No such column: {c!r}")
+
+        def pred(*vals) -> bool:
+            return sql_expr.eval_bool(node, dict(zip(cols, vals))) is True
+
+        return self.filter(pred, inputCols=cols)
+
+    def createOrReplaceTempView(self, name: str) -> None:
+        """Register this frame under ``name`` for ``engine.sql()`` queries
+        (the analog of Spark's temp-view registry, SURVEY.md §3.4)."""
+        if not name or not name.replace("_", "").isalnum():
+            raise ValueError(f"Bad view name {name!r}")
+        _temp_views[name] = self
+
     def filter(self, predicate: Callable, inputCols: Sequence[str]) -> "DataFrame":
         def op(batch: pa.RecordBatch) -> pa.RecordBatch:
+            if not inputCols:
+                # constant predicate (e.g. where("1 = 1")): zip(*[]) would
+                # yield a zero-length mask regardless of num_rows
+                keep = bool(predicate())
+                mask = pa.array([keep] * batch.num_rows, type=pa.bool_())
+                return batch.filter(mask)
             inputs = [batch.column(batch.schema.get_field_index(c)).to_pylist()
                       for c in inputCols]
             mask = pa.array([bool(predicate(*row)) for row in zip(*inputs)],
@@ -726,6 +762,42 @@ class GroupedData:
 
     def sum(self, *cols: str) -> "DataFrame":
         return self.agg({c: "sum" for c in cols})
+
+
+# ---------------------------------------------------------------------------
+# Temp views + sql() (the reference's SQL serving entry, SURVEY.md §3.4)
+# ---------------------------------------------------------------------------
+
+_temp_views: Dict[str, "DataFrame"] = {}
+
+
+def table(name: str) -> "DataFrame":
+    """The frame registered under ``name`` (createOrReplaceTempView)."""
+    try:
+        return _temp_views[name]
+    except KeyError:
+        raise KeyError(
+            f"No temp view {name!r}; registered: {sorted(_temp_views)}"
+        ) from None
+
+
+def sql(query: str) -> "DataFrame":
+    """``SELECT <exprs> FROM <view> [WHERE <condition>]`` over temp views.
+
+    The reference's serving story was literally
+    ``spark.sql("SELECT my_udf(image) FROM images")`` after
+    ``registerKerasImageUDF`` (SURVEY.md §3.4) — this makes that exact
+    string work: expressions run through ``selectExpr`` (registered
+    UDFs, nesting, aliases, literals, ``*``), the optional WHERE through
+    :meth:`DataFrame.where`. Lazy like every engine transformation.
+    """
+    from sparkdl_tpu.engine import sql_expr
+
+    parts = sql_expr.split_query(query)
+    frame = table(parts["view"])
+    if parts["where"]:
+        frame = frame.where(parts["where"])
+    return frame.selectExpr(*parts["select"])
 
 
 # ---------------------------------------------------------------------------

@@ -125,10 +125,12 @@ class _ValidatorParams(Params):
                 except StopIteration:
                     return
                 out = model.transform(val)
-                if multihost:
+                if multihost and out._process_shard is not None:
                     # transform auto-shards per process; every host must
                     # score the FULL validation output or _best_index can
-                    # diverge across hosts (and with it the refit)
+                    # diverge across hosts (and with it the refit).
+                    # Models that don't shard (e.g. LogisticRegression's
+                    # host-side transform) already return the full frame.
                     out = out.gatherProcesses()
                 scores[index] = float(self.evaluator.evaluate(out))
 
