@@ -598,6 +598,39 @@ class DataFrame:
                 raise KeyError(f"No such column: {c!r}")
         return GroupedData(self, list(cols))
 
+    def distinct(self) -> "DataFrame":
+        """Deduplicated rows (Spark's distinct; materializing, order of
+        first occurrence).
+
+        Cost note: rows convert to Python objects for hashing — O(dataset)
+        driver-side work, like Spark's own shuffle-dedup. Meant for
+        metadata frames (labels, uris), not image-blob columns.
+        """
+        table = self.toArrow()
+        if table.num_rows == 0:
+            return DataFrame.fromArrow(table, numPartitions=1)
+        seen = set()
+        keep = []
+        for i, row in enumerate(table.to_pylist()):
+            key = tuple(_freeze_value(v) for v in row.values())
+            if key not in seen:
+                seen.add(key)
+                keep.append(i)
+        return DataFrame.fromArrow(
+            table.take(pa.array(keep, type=pa.int64())),
+            numPartitions=max(1, self.numPartitions))
+
+    def sample(self, fraction: float, seed: int = 0) -> "DataFrame":
+        """Seeded Bernoulli row sample without replacement (Spark's
+        ``sample(fraction, seed)``; materializing)."""
+        if not 0.0 <= fraction <= 1.0:
+            raise ValueError(f"fraction must be in [0, 1], got {fraction}")
+        table = self.toArrow()
+        mask = np.random.default_rng(seed).random(table.num_rows) < fraction
+        return DataFrame.fromArrow(
+            table.take(pa.array(np.nonzero(mask)[0], type=pa.int64())),
+            numPartitions=max(1, self.numPartitions))
+
     def randomSplit(self, weights: Sequence[float],
                     seed: int = 0) -> List["DataFrame"]:
         """Split rows into len(weights) disjoint frames (Spark's
@@ -762,6 +795,18 @@ class GroupedData:
 
     def sum(self, *cols: str) -> "DataFrame":
         return self.agg({c: "sum" for c in cols})
+
+
+def _freeze_value(v):
+    """Row value → hashable key for distinct(): lists/dicts/bytes nest
+    arbitrarily in Arrow columns (image structs hold binary data fields)."""
+    if isinstance(v, list):
+        return tuple(_freeze_value(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _freeze_value(x)) for k, x in v.items()))
+    if isinstance(v, (bytes, bytearray)):
+        return bytes(v)
+    return v
 
 
 # ---------------------------------------------------------------------------

@@ -74,13 +74,16 @@ class LogisticRegression(Estimator, _HasClassifierCols,
                        coefficients unscaled after, so regularized fits
                        match Spark's default-standardized coefficients;
                        the intercept is never penalized.
+                       ``weightCol`` (loss = Σwᵢ·ceᵢ / Σw + penalty, r5)
+                       and ``thresholds`` (predict
+                       ``argmax(pᵢ/tᵢ)``, Spark's rule, r5).
     differs            multinomial softmax is the ONLY family (Spark's
                        binary path uses pivoted logistic; probabilities
                        agree, coefficients differ by the usual centering);
                        coefficients are NOT centered post-fit.
     absent (raises on  ``elasticNetParam`` (L1 needs a prox/OWL-QN solver,
-    no silent default) not a deliberate omission of a flag), ``weightCol``,
-                       ``thresholds``, ``lowerBoundsOnCoefficients`` et al.
+    no silent default) not a deliberate omission of a flag),
+                       ``lowerBoundsOnCoefficients`` et al.
     ================== =====================================================
     """
 
@@ -102,6 +105,17 @@ class LogisticRegression(Estimator, _HasClassifierCols,
         "changes the regularized optimum, reported coefficients are always "
         "on the original scale)",
         typeConverter=TypeConverters.toBoolean)
+    weightCol = Param(
+        "LogisticRegression", "weightCol",
+        "optional column of non-negative row weights; the loss becomes "
+        "the weighted mean cross-entropy (Spark semantics: weight 2 == "
+        "duplicating the row)",
+        typeConverter=SparkDLTypeConverters.toColumnName)
+    thresholds = Param(
+        "LogisticRegression", "thresholds",
+        "per-class thresholds; prediction = argmax_i(p_i / t_i) (Spark's "
+        "rule); length must equal the class count, values > 0",
+        typeConverter=TypeConverters.identity)
 
     @keyword_only
     def __init__(self, *, featuresCol: str = "features",
@@ -110,7 +124,9 @@ class LogisticRegression(Estimator, _HasClassifierCols,
                  probabilityCol: str = "probability",
                  maxIter: int = 100, regParam: float = 0.0,
                  tol: float = 1e-6, fitIntercept: bool = True,
-                 standardization: bool = True) -> None:
+                 standardization: bool = True,
+                 weightCol: Optional[str] = None,
+                 thresholds: Optional[list] = None) -> None:
         super().__init__()
         self._setDefault(featuresCol="features", labelCol="label",
                          predictionCol="prediction",
@@ -127,7 +143,9 @@ class LogisticRegression(Estimator, _HasClassifierCols,
                   maxIter: int = 100, regParam: float = 0.0,
                   tol: float = 1e-6,
                   fitIntercept: bool = True,
-                  standardization: bool = True) -> "LogisticRegression":
+                  standardization: bool = True,
+                  weightCol: Optional[str] = None,
+                  thresholds: Optional[list] = None) -> "LogisticRegression":
         self._set(**self._input_kwargs)
         return self
 
@@ -153,16 +171,36 @@ class LogisticRegression(Estimator, _HasClassifierCols,
     def getStandardization(self):
         return self.getOrDefault(self.standardization)
 
+    def setWeightCol(self, value):
+        return self._set(weightCol=value)
+
+    def getWeightCol(self):
+        return (self.getOrDefault(self.weightCol)
+                if self.isDefined(self.weightCol) else None)
+
+    def setThresholds(self, value):
+        return self._set(thresholds=value)
+
+    def getThresholds(self):
+        return (self.getOrDefault(self.thresholds)
+                if self.isDefined(self.thresholds) else None)
+
     def _collect_xy(self, dataset):
-        rows = dataset.select(self.getFeaturesCol(),
-                              self.getLabelCol()).collect()
-        feats, labels = [], []
+        weight_col = self.getWeightCol()
+        cols = [self.getFeaturesCol(), self.getLabelCol()]
+        if weight_col is not None:
+            cols.append(weight_col)
+        rows = dataset.select(*cols).collect()
+        feats, labels, weights = [], [], []
         for r in rows:
             f = r[self.getFeaturesCol()]
             if f is None:
                 continue
             feats.append(np.asarray(f, np.float32))
             labels.append(r[self.getLabelCol()])
+            if weight_col is not None:
+                w = r[weight_col]
+                weights.append(1.0 if w is None else float(w))
         if not feats:
             raise ValueError("no non-null feature rows to fit on")
         x = np.stack(feats)
@@ -174,30 +212,53 @@ class LogisticRegression(Estimator, _HasClassifierCols,
         y = y.astype(np.int32)
         if y.min() < 0:
             raise ValueError("labels must be non-negative class indices")
-        return x, y, int(y.max()) + 1
+        w = None
+        if weight_col is not None:
+            w = np.asarray(weights, np.float32)
+            if (w < 0).any():
+                raise ValueError(f"{weight_col!r} holds negative weights")
+        return x, y, int(y.max()) + 1, w
 
     def _fit(self, dataset) -> "LogisticRegressionModel":
-        x, y, n_classes = self._collect_xy(dataset)
+        x, y, n_classes, sample_w = self._collect_xy(dataset)
         if n_classes < 2:
             n_classes = 2
+        thresholds = self.getThresholds()
+        if thresholds is not None:
+            t = np.asarray(thresholds, np.float64)
+            if len(t) != n_classes or (t <= 0).any():
+                raise ValueError(
+                    f"thresholds must hold {n_classes} positive values, "
+                    f"got {thresholds}")
         # Spark semantics: fit in unit-std feature space (intercept
         # unpenalized and unaffected — scaling is shift-free), report
         # coefficients on the original scale.
         std = None
         if self.getStandardization() and len(x) > 1:
-            std = x.std(axis=0, ddof=1).astype(np.float32)
+            if sample_w is None:
+                std = x.std(axis=0, ddof=1)
+            else:
+                # weighted std (Spark's weighted summarizer): with integer
+                # weights this equals the duplicated sample's ddof=1 std,
+                # keeping weight-2 == duplicate-row exact under regParam
+                wsum = float(sample_w.sum())
+                mu = (sample_w[:, None] * x).sum(axis=0) / wsum
+                var = ((sample_w[:, None] * (x - mu) ** 2).sum(axis=0)
+                       / max(wsum - 1.0, 1e-12))
+                std = np.sqrt(var)
             std = np.where(std > 0, std, 1.0).astype(np.float32)
             x = x / std
         w, b, iters = _fit_softmax(
             x, y, n_classes, max_iter=self.getMaxIter(),
             reg=self.getRegParam(), tol=self.getTol(),
-            fit_intercept=self.getFitIntercept())
+            fit_intercept=self.getFitIntercept(), sample_weight=sample_w)
         if std is not None:
             w = np.asarray(w) / std[:, None]
         model = LogisticRegressionModel(
             featuresCol=self.getFeaturesCol(), labelCol=self.getLabelCol(),
             predictionCol=self.getPredictionCol(),
-            probabilityCol=self.getProbabilityCol())
+            probabilityCol=self.getProbabilityCol(),
+            thresholds=thresholds)
         model._set_weights(np.asarray(w), np.asarray(b))
         model.numIterations = int(iters)
         model._set_parent(self)
@@ -206,19 +267,25 @@ class LogisticRegression(Estimator, _HasClassifierCols,
 
 def _fit_softmax(x: np.ndarray, y: np.ndarray, n_classes: int,
                  max_iter: int, reg: float, tol: float,
-                 fit_intercept: bool):
-    """Jitted L-BFGS on mean softmax-CE + (reg/2)·||W||²; whole opt loop
-    is ONE XLA program (lax.while_loop over optax.lbfgs updates)."""
+                 fit_intercept: bool,
+                 sample_weight: Optional[np.ndarray] = None):
+    """Jitted L-BFGS on (weighted) mean softmax-CE + (reg/2)·||W||²; whole
+    opt loop is ONE XLA program (lax.while_loop over optax.lbfgs
+    updates). ``sample_weight`` gives Σwᵢ·ceᵢ/Σw — weight 2 equals
+    duplicating the row (Spark's weightCol)."""
     xd = jnp.asarray(x)
     yd = jnp.asarray(y)
+    wd = None if sample_weight is None else jnp.asarray(sample_weight)
     d = x.shape[1]
 
     def loss_fn(params):
         logits = xd @ params["w"]
         if fit_intercept:
             logits = logits + params["b"]
-        ce = optax.softmax_cross_entropy_with_integer_labels(
-            logits, yd).mean()
+        ce_rows = optax.softmax_cross_entropy_with_integer_labels(
+            logits, yd)
+        ce = (ce_rows.mean() if wd is None
+              else jnp.sum(ce_rows * wd) / jnp.sum(wd))
         return ce + 0.5 * reg * jnp.sum(params["w"] ** 2)
 
     opt = optax.lbfgs()
@@ -255,19 +322,32 @@ def _fit_softmax(x: np.ndarray, y: np.ndarray, n_classes: int,
 
 
 class LogisticRegressionModel(Model, _HasClassifierCols):
-    """Fitted model: adds prediction (+ probability) columns."""
+    """Fitted model: adds prediction (+ probability) columns.
+
+    With ``thresholds`` set, prediction is ``argmax_i(p_i / t_i)``
+    (Spark's multiclass thresholding rule); otherwise plain argmax.
+    """
+
+    thresholds = Param("LogisticRegressionModel", "thresholds",
+                       "per-class thresholds applied at prediction time",
+                       typeConverter=TypeConverters.identity)
 
     @keyword_only
     def __init__(self, *, featuresCol: str = "features",
                  labelCol: str = "label",
                  predictionCol: str = "prediction",
-                 probabilityCol: str = "probability") -> None:
+                 probabilityCol: str = "probability",
+                 thresholds: Optional[list] = None) -> None:
         super().__init__()
         self._setDefault(featuresCol="features", labelCol="label",
                          predictionCol="prediction",
                          probabilityCol="probability")
         self._set(**self._input_kwargs)
         self.numIterations: Optional[int] = None
+
+    def getThresholds(self):
+        return (self.getOrDefault(self.thresholds)
+                if self.isDefined(self.thresholds) else None)
 
     def _set_weights(self, w: np.ndarray, b: np.ndarray) -> None:
         self._w = np.asarray(w, np.float32)
@@ -310,13 +390,23 @@ class LogisticRegressionModel(Model, _HasClassifierCols):
                            else None)
             return pa.array(out, type=pa.list_(pa.float32()))
 
+        thresholds = self.getThresholds()
+        t = (np.asarray(thresholds, np.float64)
+             if thresholds is not None else None)
+
+        def decide(p):
+            if p is None:
+                return None
+            probs = np.asarray(p, np.float64)
+            if t is not None:
+                probs = probs / t  # Spark's rule: argmax(p_i / t_i)
+            return float(int(np.argmax(probs)))
+
         with_probs = dataset.withColumnBatch(
             prob_col, predict_batch,
             outputType=pa.list_(pa.float32()))
         return with_probs.withColumn(
-            self.getPredictionCol(),
-            lambda p: None if p is None else float(int(np.argmax(p))),
-            inputCols=[prob_col])
+            self.getPredictionCol(), decide, inputCols=[prob_col])
 
     # -- persistence ---------------------------------------------------------
 

@@ -116,6 +116,16 @@ _INGESTED_MODELS: Dict[str, ModelSpec] = {
         "MobileNetV3Small", None, (224, 224), preprocess_identity, 576),
     "NASNetMobile": ModelSpec(
         "NASNetMobile", None, (224, 224), preprocess_tf_mode, 1056),
+    # r5: the remaining oracle-verified ingestion families (README layer
+    # contract) exposed as named models. ResNet50V2 preprocesses in tf
+    # mode (resnet_v2 contract); EfficientNetV2/ConvNeXt normalize
+    # in-model, so device preprocess is identity.
+    "ResNet50V2": ModelSpec(
+        "ResNet50V2", None, (224, 224), preprocess_tf_mode, 2048),
+    "EfficientNetV2B0": ModelSpec(
+        "EfficientNetV2B0", None, (224, 224), preprocess_identity, 1280),
+    "ConvNeXtTiny": ModelSpec(
+        "ConvNeXtTiny", None, (224, 224), preprocess_identity, 768),
 }
 
 _INGESTED_BUILDERS = {
@@ -123,6 +133,9 @@ _INGESTED_BUILDERS = {
     "EfficientNetB0": ("efficientnet", "EfficientNetB0"),
     "MobileNetV3Small": (None, "MobileNetV3Small"),  # top-level export only
     "NASNetMobile": ("nasnet", "NASNetMobile"),
+    "ResNet50V2": ("resnet_v2", "ResNet50V2"),
+    "EfficientNetV2B0": ("efficientnet_v2", "EfficientNetV2B0"),
+    "ConvNeXtTiny": ("convnext", "ConvNeXtTiny"),
 }
 
 

@@ -409,3 +409,34 @@ def test_where_constant_predicate():
     df = DataFrame.fromRows([{"i": i} for i in range(4)], numPartitions=2)
     assert len(df.where("1 = 1").collect()) == 4
     assert len(df.where("1 = 2").collect()) == 0
+
+
+def test_distinct_and_sample():
+    from sparkdl_tpu.engine.dataframe import DataFrame
+
+    rows = [{"a": i % 3, "b": "x" if i % 2 else "y"} for i in range(12)]
+    df = DataFrame.fromRows(rows, numPartitions=3)
+    d = df.distinct().collect()
+    assert len(d) == 6  # 3 x 2 combinations
+    assert len({(r["a"], r["b"]) for r in d}) == 6
+    # first-occurrence order
+    assert d[0] == {"a": 0, "b": "y"} and d[1] == {"a": 1, "b": "x"}
+
+    big = DataFrame.fromRows([{"i": i} for i in range(1000)],
+                             numPartitions=4)
+    s = big.sample(0.3, seed=7)
+    n = s.count()
+    assert 230 <= n <= 370  # Bernoulli around 300
+    # deterministic in seed
+    assert [r["i"] for r in big.sample(0.3, seed=7).collect()] == \
+        [r["i"] for r in s.collect()]
+    with pytest.raises(ValueError, match="fraction"):
+        big.sample(1.5)
+
+
+def test_distinct_nested_columns():
+    from sparkdl_tpu.engine.dataframe import DataFrame
+
+    rows = [{"s": {"k": [1, 2]}}, {"s": {"k": [1, 2]}}, {"s": {"k": [3]}}]
+    df = DataFrame.fromRows(rows, numPartitions=2)
+    assert len(df.distinct().collect()) == 2

@@ -199,3 +199,68 @@ def test_standardization_off_differs_under_reg(rng):
         preds = np.array([r["prediction"]
                           for r in model.transform(df).collect()])
         assert (preds == y).mean() >= 0.85
+
+
+def test_weight_col_equals_row_duplication(rng):
+    """Spark's weightCol semantics: weight 2 on a row == duplicating it."""
+    x = rng.normal(size=(40, 3)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(int)
+
+    weighted_rows = [{"features": x[i].tolist(), "label": int(y[i]),
+                      "w": 2.0 if i < 10 else 1.0} for i in range(40)]
+    dup_rows = ([{"features": x[i].tolist(), "label": int(y[i])}
+                 for i in range(40)]
+                + [{"features": x[i].tolist(), "label": int(y[i])}
+                   for i in range(10)])
+    lr_w = LogisticRegression(maxIter=200, regParam=0.1, weightCol="w")
+    lr_d = LogisticRegression(maxIter=200, regParam=0.1)
+    m_w = lr_w.fit(DataFrame.fromRows(weighted_rows, numPartitions=2))
+    m_d = lr_d.fit(DataFrame.fromRows(dup_rows, numPartitions=2))
+    np.testing.assert_allclose(m_w.coefficients, m_d.coefficients,
+                               rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(m_w.intercept, m_d.intercept,
+                               rtol=1e-3, atol=1e-4)
+    # negative weights rejected
+    bad = [{"features": x[0].tolist(), "label": 0, "w": -1.0}]
+    with pytest.raises(ValueError, match="negative"):
+        lr_w.fit(DataFrame.fromRows(bad))
+
+
+def test_thresholds_shift_predictions(blobs_df, tmp_path):
+    """Spark's rule: prediction = argmax(p_i / t_i); a tiny threshold on
+    one class pulls every prediction toward it; round-trips."""
+    from sparkdl_tpu.ml import load
+
+    df, x, y = blobs_df
+    base = LogisticRegression(maxIter=100).fit(df)
+    # exact rule check on hand-set weights: probs [2/3, 1/3] with
+    # thresholds [1.0, 0.4] give p/t = [0.667, 0.833] -> class 1 wins
+    # even though argmax alone says class 0
+    hand = LogisticRegressionModel(thresholds=[1.0, 0.4])
+    hand._set_weights(np.asarray([[0.0], [0.0]], np.float32).T,
+                      np.asarray([np.log(2.0), 0.0], np.float32))
+    one_row = DataFrame.fromRows([{"features": [0.0]}])
+    out = hand.transform(one_row).collect()
+    np.testing.assert_allclose(out[0]["probability"], [2 / 3, 1 / 3],
+                               rtol=1e-5)
+    assert out[0]["prediction"] == 1.0
+    # a tiny threshold pulls the bulk of predictions toward class 0
+    # (rows whose p0 underflows to exactly 0.0 keep their own class)
+    tiny = 1e-9
+    biased = LogisticRegression(
+        maxIter=100, thresholds=[tiny, 1.0, 1.0]).fit(df)
+    preds = np.array([r["prediction"]
+                      for r in biased.transform(df).collect()])
+    assert (preds == 0.0).mean() > 0.8
+    # validation: wrong length / nonpositive
+    with pytest.raises(ValueError, match="thresholds"):
+        LogisticRegression(thresholds=[1.0, 1.0]).fit(df)
+    with pytest.raises(ValueError, match="thresholds"):
+        LogisticRegression(thresholds=[0.0, 1.0, 1.0]).fit(df)
+    # persistence keeps the rule
+    biased.save(str(tmp_path / "thr"))
+    loaded = load(str(tmp_path / "thr"))
+    lp = np.array([r["prediction"]
+                   for r in loaded.transform(df).collect()])
+    np.testing.assert_array_equal(lp, preds)
+    assert base.getThresholds() is None

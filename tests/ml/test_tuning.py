@@ -398,3 +398,15 @@ def test_tuning_persistence_rejects_unserializable_grid(tmp_path, blobs_df):
                         evaluator=other, numFolds=2)
     with pytest.raises(ValueError, match="does not own"):
         cv.save(str(tmp_path / "bad"))
+
+
+def test_regression_evaluator_large_mean_r2():
+    """r2 must survive labels with a huge mean (streaming Welford merge,
+    not the cancelling raw-moment form)."""
+    base = 1e8
+    rows = [{"prediction": base + v + 0.1, "label": base + v}
+            for v in (0.0, 1.0, 2.0)]
+    df = DataFrame.fromRows(rows, numPartitions=3)
+    r2 = RegressionEvaluator(metricName="r2").evaluate(df)
+    # SStot = 2.0, SSres = 3 * 0.01 -> r2 = 1 - 0.03/2
+    assert r2 == pytest.approx(1.0 - 0.03 / 2.0, rel=1e-6)

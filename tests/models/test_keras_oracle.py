@@ -55,3 +55,45 @@ def _run_pair(name, tol):
                          ids=[f[0] for f in FAMILIES])
 def test_keras_oracle(name, tol):
     _run_pair(name, tol)
+
+
+# -- r5: ingestion-backed named models, featurizer-role oracle ---------------
+
+INGESTED_FAMILIES = [
+    # (name, keras preprocess module attr or None for in-model scaling)
+    ("ResNet50V2", "resnet_v2"),
+    ("EfficientNetV2B0", None),
+    ("ConvNeXtTiny", None),
+]
+
+
+@pytest.mark.parametrize("name,pre_module", INGESTED_FAMILIES,
+                         ids=[f[0] for f in INGESTED_FAMILIES])
+def test_ingested_named_featurizer_oracle(name, pre_module):
+    """The r5 ingestion-backed names: DeepImageFeaturizer's ModelFunction
+    (device preprocess composed in front of the walker's program) must
+    match the keras model's own forward after the family's documented
+    preprocess_input — validating the registry's preprocess mode and
+    feature_dim per name, not just the walker per layer."""
+    import importlib
+
+    spec = registry.get_model_spec(name)
+    h, w = spec.input_size
+    ctor = registry._resolve_keras_ctor(name)
+    kmodel = ctor(weights=None, include_top=False, pooling="avg",
+                  input_shape=(h, w, 3))
+    mf = registry.build_featurizer(name, weights=kmodel)
+
+    rng = np.random.default_rng(0)
+    x = rng.uniform(0, 255, size=(2, h, w, 3)).astype(np.float32)
+    got = np.asarray(mf.apply_fn(mf.variables, x))
+    assert got.shape == (2, spec.feature_dim)
+
+    if pre_module is not None:
+        pre = importlib.import_module(
+            f"keras.applications.{pre_module}").preprocess_input
+        x_ref = pre(x.copy())
+    else:
+        x_ref = x  # family normalizes in-model (identity preprocess)
+    want = np.asarray(kmodel(x_ref))
+    np.testing.assert_allclose(got, want, atol=5e-4, rtol=1e-3)
