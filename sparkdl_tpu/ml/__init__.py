@@ -20,6 +20,11 @@ from sparkdl_tpu.ml.classification import (
     LogisticRegressionModel,
 )
 from sparkdl_tpu.ml.estimator import KerasImageFileEstimator, KerasImageFileModel
+from sparkdl_tpu.ml.feature import (
+    IndexToString,
+    StringIndexer,
+    StringIndexerModel,
+)
 from sparkdl_tpu.ml.evaluation import (
     BinaryClassificationEvaluator,
     MulticlassClassificationEvaluator,
@@ -56,8 +61,11 @@ __all__ = [
     "RegressionEvaluator",
     "TrainValidationSplit",
     "TrainValidationSplitModel",
+    "IndexToString",
     "KerasImageFileEstimator",
     "KerasImageFileModel",
+    "StringIndexer",
+    "StringIndexerModel",
     "KerasImageFileTransformer",
     "KerasTransformer",
     "LogisticRegression",

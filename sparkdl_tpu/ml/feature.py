@@ -1,0 +1,208 @@
+"""Feature stages: StringIndexer / IndexToString.
+
+Parity: Spark ML's label-indexing pair. The reference's flagship
+pipeline (``Pipeline([DeepImageFeaturizer, LogisticRegression])``,
+upstream README) assumed Spark ML around it — real datasets carry string
+labels, and Spark users put ``StringIndexer`` in front of the classifier
+and ``IndexToString`` behind it. Same semantics here:
+
+- ``StringIndexer.fit`` orders labels by ``stringOrderType``
+  (``frequencyDesc`` default, ties and alphabet orders broken
+  alphabetically like Spark) and the model maps values to float indices.
+- ``handleInvalid``: ``error`` (raise on unseen values), ``skip`` (drop
+  those rows), ``keep`` (index them as ``len(labels)``).
+- ``IndexToString`` inverts with an explicit ``labels`` list or the
+  one a ``StringIndexerModel`` learned.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import List, Optional
+
+from sparkdl_tpu.ml.base import Estimator, Model, Transformer
+from sparkdl_tpu.ml.persistence import ParamsOnlyPersistence
+from sparkdl_tpu.param.base import Param, Params, keyword_only
+from sparkdl_tpu.param.converters import SparkDLTypeConverters, TypeConverters
+
+_ORDER_TYPES = ("frequencyDesc", "frequencyAsc", "alphabetDesc",
+                "alphabetAsc")
+_INVALID_POLICIES = ("error", "skip", "keep")
+
+
+class _IndexerParams(Params):
+    inputCol = Param("_IndexerParams", "inputCol", "input column",
+                     typeConverter=SparkDLTypeConverters.toColumnName)
+    outputCol = Param("_IndexerParams", "outputCol", "output column",
+                      typeConverter=SparkDLTypeConverters.toColumnName)
+
+    def setInputCol(self, value):
+        return self._set(inputCol=value)
+
+    def getInputCol(self):
+        return self.getOrDefault(self.inputCol)
+
+    def setOutputCol(self, value):
+        return self._set(outputCol=value)
+
+    def getOutputCol(self):
+        return self.getOrDefault(self.outputCol)
+
+
+class StringIndexer(Estimator, _IndexerParams, ParamsOnlyPersistence):
+    """Learn a string→index mapping over a column (Spark semantics)."""
+
+    stringOrderType = Param(
+        "StringIndexer", "stringOrderType", f"one of {_ORDER_TYPES}",
+        typeConverter=SparkDLTypeConverters.supportedNameConverter(
+            list(_ORDER_TYPES)))
+    handleInvalid = Param(
+        "StringIndexer", "handleInvalid", f"one of {_INVALID_POLICIES}",
+        typeConverter=SparkDLTypeConverters.supportedNameConverter(
+            list(_INVALID_POLICIES)))
+
+    @keyword_only
+    def __init__(self, *, inputCol: Optional[str] = None,
+                 outputCol: Optional[str] = None,
+                 stringOrderType: str = "frequencyDesc",
+                 handleInvalid: str = "error") -> None:
+        super().__init__()
+        self._setDefault(stringOrderType="frequencyDesc",
+                         handleInvalid="error")
+        self._set(**self._input_kwargs)
+
+    def setStringOrderType(self, value):
+        return self._set(stringOrderType=value)
+
+    def getStringOrderType(self):
+        return self.getOrDefault(self.stringOrderType)
+
+    def setHandleInvalid(self, value):
+        return self._set(handleInvalid=value)
+
+    def getHandleInvalid(self):
+        return self.getOrDefault(self.handleInvalid)
+
+    def _fit(self, dataset) -> "StringIndexerModel":
+        col = self.getInputCol()
+        counts: Counter = Counter()
+        saw_null = False
+        for batch in dataset.select(col).streamPartitions():
+            for v in batch.column(0).to_pylist():
+                if v is None:
+                    saw_null = True
+                else:
+                    counts[str(v)] += 1
+        if saw_null and self.getHandleInvalid() == "error":
+            # Spark semantics: NULL is invalid data, subject to the policy
+            raise ValueError(
+                f"{col!r} contains NULL values (handleInvalid='error'; "
+                "use 'skip' or 'keep')")
+        if not counts:
+            raise ValueError(f"no non-null values in {col!r} to index")
+        order = self.getStringOrderType()
+        if order == "frequencyDesc":
+            # Spark tie-break: alphabetical among equal frequencies
+            labels = sorted(counts, key=lambda v: (-counts[v], v))
+        elif order == "frequencyAsc":
+            labels = sorted(counts, key=lambda v: (counts[v], v))
+        elif order == "alphabetDesc":
+            labels = sorted(counts, reverse=True)
+        else:
+            labels = sorted(counts)
+        model = StringIndexerModel(
+            inputCol=col, outputCol=self.getOutputCol(),
+            handleInvalid=self.getHandleInvalid(), labels=labels)
+        model._set_parent(self)
+        return model
+
+
+class StringIndexerModel(Model, _IndexerParams, ParamsOnlyPersistence):
+    """Fitted mapping: ``labels[i] -> float(i)``."""
+
+    handleInvalid = Param(
+        "StringIndexerModel", "handleInvalid",
+        f"one of {_INVALID_POLICIES}",
+        typeConverter=SparkDLTypeConverters.supportedNameConverter(
+            list(_INVALID_POLICIES)))
+    labels = Param("StringIndexerModel", "labels",
+                   "ordered label list (index = position)",
+                   typeConverter=TypeConverters.toListString)
+
+    @keyword_only
+    def __init__(self, *, inputCol: Optional[str] = None,
+                 outputCol: Optional[str] = None,
+                 handleInvalid: str = "error",
+                 labels: Optional[List[str]] = None) -> None:
+        super().__init__()
+        self._setDefault(handleInvalid="error")
+        self._set(**self._input_kwargs)
+
+    def getLabels(self) -> List[str]:
+        return list(self.getOrDefault(self.labels))
+
+    def getHandleInvalid(self):
+        return self.getOrDefault(self.handleInvalid)
+
+    def _transform(self, dataset):
+        col = self.getInputCol()
+        out = self.getOutputCol()
+        labels = self.getLabels()
+        index = {v: float(i) for i, v in enumerate(labels)}
+        policy = self.getHandleInvalid()
+
+        # Spark semantics: NULL counts as invalid data like an unseen
+        # label — error raises, skip drops the row, keep maps to numLabels
+        if policy == "skip":
+            dataset = dataset.filter(
+                lambda v: v is not None and str(v) in index,
+                inputCols=[col])
+
+        def lookup(v):
+            if v is not None and str(v) in index:
+                return index[str(v)]
+            if policy == "keep":
+                return float(len(labels))
+            raise ValueError(
+                f"Invalid label {v!r} in {col!r} (handleInvalid='error'; "
+                "use 'skip' or 'keep')")
+
+        import pyarrow as pa
+
+        return dataset.withColumn(out, lookup, inputCols=[col],
+                                  outputType=pa.float64())
+
+
+class IndexToString(Transformer, _IndexerParams, ParamsOnlyPersistence):
+    """Inverse mapping: float index column → label string column."""
+
+    labels = Param("IndexToString", "labels", "ordered label list",
+                   typeConverter=TypeConverters.toListString)
+
+    @keyword_only
+    def __init__(self, *, inputCol: Optional[str] = None,
+                 outputCol: Optional[str] = None,
+                 labels: Optional[List[str]] = None) -> None:
+        super().__init__()
+        self._set(**self._input_kwargs)
+
+    def getLabels(self) -> List[str]:
+        return list(self.getOrDefault(self.labels))
+
+    def _transform(self, dataset):
+        labels = self.getLabels()
+
+        def lookup(v):
+            if v is None:
+                return None
+            i = int(v)
+            if not 0 <= i < len(labels):
+                raise ValueError(
+                    f"index {i} out of range for {len(labels)} labels")
+            return labels[i]
+
+        import pyarrow as pa
+
+        return dataset.withColumn(self.getOutputCol(), lookup,
+                                  inputCols=[self.getInputCol()],
+                                  outputType=pa.string())

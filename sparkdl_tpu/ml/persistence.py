@@ -48,6 +48,9 @@ _LOADABLE = {
     "sparkdl_tpu.ml.estimator.KerasImageFileModel",
     "sparkdl_tpu.ml.base.Pipeline",
     "sparkdl_tpu.ml.base.PipelineModel",
+    "sparkdl_tpu.ml.feature.StringIndexer",
+    "sparkdl_tpu.ml.feature.StringIndexerModel",
+    "sparkdl_tpu.ml.feature.IndexToString",
     "sparkdl_tpu.ml.evaluation.MulticlassClassificationEvaluator",
     "sparkdl_tpu.ml.evaluation.RegressionEvaluator",
     "sparkdl_tpu.ml.evaluation.BinaryClassificationEvaluator",

@@ -1,0 +1,108 @@
+"""StringIndexer / IndexToString — the Spark feature stages around the
+reference's flagship pipeline (string labels in, readable predictions
+out). Oracles: Spark's ordering rules (frequencyDesc with alphabetical
+tie-break), the three handleInvalid policies, round-trips, and the full
+indexer → LR → inverse pipeline."""
+
+import numpy as np
+import pytest
+
+from sparkdl_tpu.engine.dataframe import DataFrame
+from sparkdl_tpu.ml import (
+    IndexToString,
+    LogisticRegression,
+    Pipeline,
+    StringIndexer,
+    StringIndexerModel,
+    load,
+)
+
+
+@pytest.fixture
+def fruit_df():
+    rows = ([{"fruit": "apple"}] * 3 + [{"fruit": "banana"}] * 3
+            + [{"fruit": "cherry"}])
+    return DataFrame.fromRows(rows, numPartitions=2)
+
+
+def test_order_types(fruit_df):
+    def labels(order):
+        return StringIndexer(inputCol="fruit", outputCol="i",
+                             stringOrderType=order).fit(fruit_df).getLabels()
+
+    # frequencyDesc: apple(3) and banana(3) tie -> alphabetical
+    assert labels("frequencyDesc") == ["apple", "banana", "cherry"]
+    assert labels("frequencyAsc") == ["cherry", "apple", "banana"]
+    assert labels("alphabetAsc") == ["apple", "banana", "cherry"]
+    assert labels("alphabetDesc") == ["cherry", "banana", "apple"]
+
+
+def test_transform_indices(fruit_df):
+    model = StringIndexer(inputCol="fruit", outputCol="i").fit(fruit_df)
+    out = model.transform(fruit_df).collect()
+    assert [r["i"] for r in out] == [0.0, 0.0, 0.0, 1.0, 1.0, 1.0, 2.0]
+
+
+def test_handle_invalid_policies(fruit_df):
+    """Spark semantics: unseen labels AND nulls are invalid data —
+    error raises, skip drops the row, keep maps to numLabels."""
+    model = StringIndexer(inputCol="fruit", outputCol="i").fit(fruit_df)
+    unseen = DataFrame.fromRows([{"fruit": "durian"}, {"fruit": "apple"},
+                                 {"fruit": None}])
+    with pytest.raises(Exception, match="durian|Invalid"):
+        model.transform(unseen).collect()
+    keep = model.copy({model.handleInvalid: "keep"}).transform(unseen)
+    assert [r["i"] for r in keep.collect()] == [3.0, 0.0, 3.0]
+    skip = model.copy({model.handleInvalid: "skip"}).transform(unseen)
+    assert [r["i"] for r in skip.collect()] == [0.0]
+    # fit itself rejects nulls under the default policy
+    with_null = DataFrame.fromRows([{"fruit": "a"}, {"fruit": None}])
+    with pytest.raises(ValueError, match="NULL"):
+        StringIndexer(inputCol="fruit", outputCol="i").fit(with_null)
+    assert StringIndexer(inputCol="fruit", outputCol="i",
+                         handleInvalid="keep").fit(with_null).getLabels() \
+        == ["a"]
+    # labels params are type-checked at construction
+    with pytest.raises(TypeError, match="list"):
+        IndexToString(inputCol="i", outputCol="s", labels="abc")
+
+
+def test_index_to_string_roundtrip(fruit_df, tmp_path):
+    model = StringIndexer(inputCol="fruit", outputCol="i").fit(fruit_df)
+    inverse = IndexToString(inputCol="i", outputCol="back",
+                            labels=model.getLabels())
+    out = inverse.transform(model.transform(fruit_df)).collect()
+    for r in out:
+        assert r["back"] == r["fruit"]
+    # persistence round-trips for all three stages
+    model.save(str(tmp_path / "sim"))
+    loaded = load(str(tmp_path / "sim"))
+    assert isinstance(loaded, StringIndexerModel)
+    assert loaded.getLabels() == model.getLabels()
+    inverse.save(str(tmp_path / "its"))
+    assert load(str(tmp_path / "its")).getLabels() == model.getLabels()
+    si = StringIndexer(inputCol="fruit", outputCol="i",
+                       stringOrderType="alphabetAsc")
+    si.save(str(tmp_path / "si"))
+    assert load(str(tmp_path / "si")).getStringOrderType() == "alphabetAsc"
+
+
+def test_pipeline_with_string_labels(rng):
+    """String labels end-to-end: StringIndexer -> LogisticRegression,
+    then IndexToString maps predictions back to label strings."""
+    x = rng.normal(size=(60, 3)).astype(np.float32)
+    y = np.where(x[:, 0] > 0, "pos", "neg")
+    df = DataFrame.fromRows(
+        [{"features": x[i].tolist(), "cls": str(y[i])} for i in range(60)],
+        numPartitions=2)
+    pipe = Pipeline(stages=[
+        StringIndexer(inputCol="cls", outputCol="label"),
+        LogisticRegression(maxIter=100),
+    ])
+    fitted = pipe.fit(df)
+    indexer = fitted.stages[0]
+    out = IndexToString(inputCol="prediction", outputCol="pred_cls",
+                        labels=indexer.getLabels()).transform(
+        fitted.transform(df)).collect()
+    acc = np.mean([r["pred_cls"] == r["cls"] for r in out])
+    assert acc >= 0.9
