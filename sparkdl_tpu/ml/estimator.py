@@ -137,6 +137,25 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         for both the streaming and collected fit paths)."""
         return "bfloat16" if fit_params.get("mixed_precision") else None
 
+    @staticmethod
+    def _check_multihost_mesh(mesh, num_proc: int) -> int:
+        """Shared multi-host guards for both fit paths; returns the data
+        axis size. A model-parallel mesh whose data axis is smaller than
+        the process count would make the local share 0 (ZeroDivisionError
+        downstream)."""
+        from sparkdl_tpu.core.mesh import data_axis_size
+
+        if mesh is None:
+            raise ValueError(
+                "multi-host fit requires a mesh (the data axis carries "
+                "the per-host shards)")
+        axis = data_axis_size(mesh)
+        if axis % num_proc != 0:
+            raise ValueError(
+                f"multi-host fit needs the mesh data axis ({axis}) to be "
+                f"a multiple of the process count ({num_proc})")
+        return axis
+
     # -- data staging --------------------------------------------------------
 
     def _loaded_frame(self, dataset):
@@ -290,18 +309,7 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
             multiple = data_axis_size(mesh)
             batch_size = pad_to_multiple(batch_size, multiple)
         if num_proc > 1:
-            if mesh is None:
-                raise ValueError(
-                    "multi-host fit requires a mesh (the data axis carries "
-                    "the per-host shards)")
-            if multiple % num_proc != 0:
-                # data_axis_size comes from the user's MeshConfig; a
-                # model-parallel mesh with data < process_count would make
-                # the local share 0 (ZeroDivisionError downstream)
-                raise ValueError(
-                    f"multi-host fit needs the mesh data axis "
-                    f"({multiple}) to be a multiple of the process count "
-                    f"({num_proc})")
+            self._check_multihost_mesh(mesh, num_proc)
             # validation_data works multi-host: state is replicated, so
             # Trainer.evaluate pulls it host-local and every process
             # computes the exact single-process metrics (r5; the
@@ -410,6 +418,41 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         usable = (n // batch_size) * batch_size
         batches = [(x[i:i + batch_size], y[i:i + batch_size])
                    for i in range(0, usable, batch_size)]
+
+        # Multi-host collected fit (r5): Trainer.stage_batch assembles the
+        # global array from PROCESS-LOCAL shards, so feeding the full
+        # batch on every host would silently duplicate the data. Each
+        # host takes its contiguous slice of every (host-identical)
+        # global batch — shard order matches make_array_from_
+        # process_local_data's process-order concatenation, so params
+        # equal the single-process fit exactly.
+        num_proc = jax.process_count()
+        if num_proc > 1:
+            self._check_multihost_mesh(mesh, num_proc)
+            # One cheap collective up front: every host must have
+            # collected the same row count, or (one host dropping an
+            # undecodable image) batch counts diverge and the short host
+            # exits the loop while the others block in the next
+            # collective forever — the collected-path analog of the
+            # streaming path's per-batch lockstep.
+            from jax.experimental import multihost_utils
+
+            counts = multihost_utils.process_allgather(
+                np.asarray([len(x)], dtype=np.int64))
+            if int(counts.min()) != int(counts.max()):
+                raise ValueError(
+                    "multi-host collected fit needs every process to "
+                    "decode the same rows; got per-host counts "
+                    f"{counts.ravel().tolist()} — check for corrupt or "
+                    "host-unreadable images, or use streaming=True "
+                    "(lockstep tolerates uneven decode)")
+            # batch_size is a multiple of the data axis here, and the
+            # axis is a multiple of num_proc, so the slice is exact
+            local = batch_size // num_proc
+            p = jax.process_index()
+            batches = [(bx[p * local:(p + 1) * local],
+                        by[p * local:(p + 1) * local])
+                       for bx, by in batches]
 
         trainer, state = Trainer.from_model_function(
             mf, loss=self.getKerasLoss(), optimizer=self.getKerasOptimizer(),

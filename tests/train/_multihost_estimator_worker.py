@@ -72,15 +72,29 @@ def flat_params(model) -> np.ndarray:
                            for leaf in jax.tree.leaves(params)])
 
 
+def collected_fit(est, df):
+    """The collected (streaming=False) path under multi-host: each host
+    must slice its share of every global batch (r5)."""
+    est = est.copy()
+    fp = est.getKerasFitParams()
+    fp["streaming"] = False
+    fp["shuffle"] = False
+    est.setKerasFitParams(fp)
+    return est.fit(df)
+
+
 def main(data_dir: str, out_dir: str) -> None:
     assert maybe_initialize_distributed(), "SPARKDL_* env triple not set"
     assert jax.process_count() == 2, jax.process_count()
     mesh = make_mesh(MeshConfig(data=8))
     est, df = build_estimator(data_dir, mesh)
     model = est.fit(df)
+    collected = collected_fit(est, df)
     if jax.process_index() == 0:
         np.save(os.path.join(out_dir, "multihost_estimator_params.npy"),
                 flat_params(model))
+        np.save(os.path.join(out_dir, "multihost_collected_params.npy"),
+                flat_params(collected))
         with open(os.path.join(out_dir,
                                "multihost_estimator_history.json"), "w") as f:
             json.dump(model.history["epochs"], f)

@@ -202,6 +202,12 @@ def test_two_process_estimator_fit_matches_single(tmp_path):
     model = est.fit(df)
     want = w.flat_params(model)
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    # collected (streaming=False) path: per-host batch slicing must equal
+    # the single-process collected fit (r5)
+    got_collected = np.load(tmp_path / "multihost_collected_params.npy")
+    want_collected = w.flat_params(w.collected_fit(est, df))
+    np.testing.assert_allclose(got_collected, want_collected,
+                               rtol=1e-5, atol=1e-6)
     # epoch-end validation under multi-host (VERDICT r4 #7): history equals
     # the single-process fit's
     want_history = model.history["epochs"]
