@@ -22,8 +22,10 @@ from sparkdl_tpu.ml.classification import (
 from sparkdl_tpu.ml.estimator import KerasImageFileEstimator, KerasImageFileModel
 from sparkdl_tpu.ml.feature import (
     IndexToString,
+    OneHotEncoder,
     StringIndexer,
     StringIndexerModel,
+    VectorAssembler,
 )
 from sparkdl_tpu.ml.evaluation import (
     BinaryClassificationEvaluator,
@@ -71,12 +73,14 @@ __all__ = [
     "LogisticRegression",
     "LogisticRegressionModel",
     "Model",
+    "OneHotEncoder",
     "Pipeline",
     "load",
     "PipelineModel",
     "Transformer",
     "TPUImageTransformer",
     "TPUTransformer",
+    "VectorAssembler",
     "TFImageTransformer",
     "TFTransformer",
 ]

@@ -1,18 +1,25 @@
-"""Feature stages: StringIndexer / IndexToString.
+"""Feature stages: StringIndexer / IndexToString / VectorAssembler /
+OneHotEncoder.
 
-Parity: Spark ML's label-indexing pair. The reference's flagship
-pipeline (``Pipeline([DeepImageFeaturizer, LogisticRegression])``,
-upstream README) assumed Spark ML around it — real datasets carry string
-labels, and Spark users put ``StringIndexer`` in front of the classifier
-and ``IndexToString`` behind it. Same semantics here:
+Parity: the Spark ML feature stages real reference-era pipelines put
+around ``Pipeline([DeepImageFeaturizer, LogisticRegression])`` (upstream
+README assumed Spark ML): string labels in, assembled feature vectors,
+readable predictions out. Semantics per stage:
 
 - ``StringIndexer.fit`` orders labels by ``stringOrderType``
   (``frequencyDesc`` default, ties and alphabet orders broken
-  alphabetically like Spark) and the model maps values to float indices.
-- ``handleInvalid``: ``error`` (raise on unseen values), ``skip`` (drop
-  those rows), ``keep`` (index them as ``len(labels)``).
-- ``IndexToString`` inverts with an explicit ``labels`` list or the
-  one a ``StringIndexerModel`` learned.
+  alphabetically like Spark) and the model maps values to float indices;
+  ``handleInvalid`` = ``error``/``skip``/``keep`` applies to unseen
+  labels AND nulls (Spark's invalid-data contract).
+- ``IndexToString`` inverts with an explicit ``labels`` list or the one
+  a ``StringIndexerModel`` learned.
+- ``VectorAssembler`` concatenates numeric scalar and vector columns
+  into one vector column in input order; ``handleInvalid`` =
+  ``error``/``skip``/``keep`` (keep pads null scalars as NaN, Spark's
+  rule; a null vector cell cannot be kept — its width is unknown).
+- ``OneHotEncoder`` maps a category-index column to an indicator vector
+  with Spark's ``dropLast=True`` default (the last category encodes as
+  all-zeros).
 """
 
 from __future__ import annotations
@@ -171,6 +178,167 @@ class StringIndexerModel(Model, _IndexerParams, ParamsOnlyPersistence):
 
         return dataset.withColumn(out, lookup, inputCols=[col],
                                   outputType=pa.float64())
+
+
+class VectorAssembler(Transformer, Params, ParamsOnlyPersistence):
+    """Concatenate numeric/vector columns into one vector column."""
+
+    inputCols = Param("VectorAssembler", "inputCols",
+                      "columns to concatenate, in order",
+                      typeConverter=TypeConverters.toListString)
+    outputCol = Param("VectorAssembler", "outputCol", "output column",
+                      typeConverter=SparkDLTypeConverters.toColumnName)
+    handleInvalid = Param(
+        "VectorAssembler", "handleInvalid", f"one of {_INVALID_POLICIES}",
+        typeConverter=SparkDLTypeConverters.supportedNameConverter(
+            list(_INVALID_POLICIES)))
+
+    @keyword_only
+    def __init__(self, *, inputCols: Optional[List[str]] = None,
+                 outputCol: Optional[str] = None,
+                 handleInvalid: str = "error") -> None:
+        super().__init__()
+        self._setDefault(handleInvalid="error")
+        self._set(**self._input_kwargs)
+
+    def setInputCols(self, value):
+        return self._set(inputCols=value)
+
+    def getInputCols(self):
+        return list(self.getOrDefault(self.inputCols))
+
+    def setOutputCol(self, value):
+        return self._set(outputCol=value)
+
+    def getOutputCol(self):
+        return self.getOrDefault(self.outputCol)
+
+    def getHandleInvalid(self):
+        return self.getOrDefault(self.handleInvalid)
+
+    def _transform(self, dataset):
+        import pyarrow as pa
+
+        cols = self.getInputCols()
+        if not cols:
+            raise ValueError("inputCols must name at least one column")
+        for c in cols:
+            if c not in dataset.columns:
+                raise KeyError(f"No such column: {c!r}")
+        policy = self.getHandleInvalid()
+        # Schema-derived column kinds: a null VECTOR cell has unknown
+        # width, so even 'keep' must raise for it (a single NaN would
+        # make the assembled column ragged and crash/misalign the
+        # downstream learner far from the cause — Spark raises too).
+        vector_cols = {
+            c for c in cols
+            if pa.types.is_list(dataset.schema.field(c).type)
+            or pa.types.is_fixed_size_list(dataset.schema.field(c).type)
+            or pa.types.is_large_list(dataset.schema.field(c).type)}
+
+        if policy == "skip":
+            dataset = dataset.dropna(subset=cols)
+
+        def assemble(*vals):
+            out: List[float] = []
+            for c, v in zip(cols, vals):
+                if v is None:
+                    if policy == "keep" and c not in vector_cols:
+                        out.append(float("nan"))  # Spark: null scalar→NaN
+                        continue
+                    raise ValueError(
+                        f"NULL in {c!r} "
+                        + ("(vector column: width unknown, cannot keep)"
+                           if c in vector_cols else
+                           "(handleInvalid='error'; use 'skip' or 'keep')"))
+                if isinstance(v, (list, tuple)):
+                    out.extend(float(x) for x in v)
+                else:
+                    out.append(float(v))
+            return out
+
+        return dataset.withColumn(self.getOutputCol(), assemble,
+                                  inputCols=cols,
+                                  outputType=pa.list_(pa.float32()))
+
+
+class OneHotEncoder(Transformer, _IndexerParams, ParamsOnlyPersistence):
+    """Category-index column → indicator vector (Spark semantics:
+    ``dropLast=True`` encodes the last category as all-zeros;
+    ``handleInvalid='keep'`` widens the vector by one extra category for
+    invalid values — nulls and out-of-range indices — while the default
+    ``'error'`` raises at the encoder, naming the column)."""
+
+    numCategories = Param("OneHotEncoder", "numCategories",
+                          "category count (vector width before dropLast)",
+                          typeConverter=TypeConverters.toInt)
+    dropLast = Param("OneHotEncoder", "dropLast",
+                     "encode the last category as all-zeros (Spark "
+                     "default True)",
+                     typeConverter=TypeConverters.toBoolean)
+    handleInvalid = Param(
+        "OneHotEncoder", "handleInvalid",
+        "'error' (raise on null/out-of-range) or 'keep' (extra category)",
+        typeConverter=SparkDLTypeConverters.supportedNameConverter(
+            ["error", "keep"]))
+
+    @keyword_only
+    def __init__(self, *, inputCol: Optional[str] = None,
+                 outputCol: Optional[str] = None,
+                 numCategories: Optional[int] = None,
+                 dropLast: bool = True,
+                 handleInvalid: str = "error") -> None:
+        super().__init__()
+        self._setDefault(dropLast=True, handleInvalid="error")
+        self._set(**self._input_kwargs)
+
+    def getNumCategories(self):
+        return (self.getOrDefault(self.numCategories)
+                if self.isDefined(self.numCategories) else None)
+
+    def getDropLast(self):
+        return self.getOrDefault(self.dropLast)
+
+    def getHandleInvalid(self):
+        return self.getOrDefault(self.handleInvalid)
+
+    def _transform(self, dataset):
+        import pyarrow as pa
+
+        n = self.getNumCategories()
+        if n is None or n < 2:
+            raise ValueError(f"numCategories must be >= 2, got {n}")
+        col = self.getInputCol()
+        keep = self.getHandleInvalid() == "keep"
+        # Spark widths: keep adds an extra "invalid" category; dropLast
+        # drops one. keep+dropLast: invalid encodes as all-zeros.
+        width = n + (1 if keep else 0) - (1 if self.getDropLast() else 0)
+
+        def encode(v):
+            invalid = v is None
+            i = -1
+            if not invalid:
+                i = int(v)
+                if float(v) != i:
+                    # a fractional index is a wiring mistake (probability
+                    # column?), never valid data — always raise
+                    raise ValueError(
+                        f"category index {v!r} in {col!r} is not integral")
+                invalid = not 0 <= i < n
+            if invalid:
+                if not keep:
+                    raise ValueError(
+                        f"invalid category {v!r} in {col!r} "
+                        "(handleInvalid='error'; use 'keep')")
+                i = n  # the extra category (all-zeros when dropped)
+            vec = [0.0] * width
+            if i < width:
+                vec[i] = 1.0
+            return vec
+
+        return dataset.withColumn(self.getOutputCol(), encode,
+                                  inputCols=[col],
+                                  outputType=pa.list_(pa.float32()))
 
 
 class IndexToString(Transformer, _IndexerParams, ParamsOnlyPersistence):

@@ -51,6 +51,8 @@ _LOADABLE = {
     "sparkdl_tpu.ml.feature.StringIndexer",
     "sparkdl_tpu.ml.feature.StringIndexerModel",
     "sparkdl_tpu.ml.feature.IndexToString",
+    "sparkdl_tpu.ml.feature.VectorAssembler",
+    "sparkdl_tpu.ml.feature.OneHotEncoder",
     "sparkdl_tpu.ml.evaluation.MulticlassClassificationEvaluator",
     "sparkdl_tpu.ml.evaluation.RegressionEvaluator",
     "sparkdl_tpu.ml.evaluation.BinaryClassificationEvaluator",

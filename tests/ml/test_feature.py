@@ -106,3 +106,97 @@ def test_pipeline_with_string_labels(rng):
         fitted.transform(df)).collect()
     acc = np.mean([r["pred_cls"] == r["cls"] for r in out])
     assert acc >= 0.9
+
+
+def test_vector_assembler():
+    from sparkdl_tpu.ml import VectorAssembler
+
+    rows = [{"a": 1.0, "v": [2.0, 3.0], "b": 4},
+            {"a": None, "v": [5.0, 6.0], "b": 7}]
+    df = DataFrame.fromRows(rows, numPartitions=1)
+    va = VectorAssembler(inputCols=["a", "v", "b"], outputCol="features")
+    with pytest.raises(Exception, match="NULL"):
+        va.transform(df).collect()
+    keep = VectorAssembler(inputCols=["a", "v", "b"], outputCol="features",
+                           handleInvalid="keep").transform(df).collect()
+    assert keep[0]["features"] == [1.0, 2.0, 3.0, 4.0]
+    got = keep[1]["features"]
+    assert np.isnan(got[0]) and got[1:] == [5.0, 6.0, 7.0]
+    skip = VectorAssembler(inputCols=["a", "v", "b"], outputCol="features",
+                           handleInvalid="skip").transform(df).collect()
+    assert len(skip) == 1 and skip[0]["features"] == [1.0, 2.0, 3.0, 4.0]
+    with pytest.raises(KeyError, match="nope"):
+        VectorAssembler(inputCols=["nope"], outputCol="f").transform(df) \
+            .collect()
+
+
+def test_one_hot_encoder(tmp_path):
+    from sparkdl_tpu.ml import OneHotEncoder, load
+
+    clean = DataFrame.fromRows([{"i": 0.0}, {"i": 1.0}, {"i": 2.0}],
+                               numPartitions=2)
+    enc = OneHotEncoder(inputCol="i", outputCol="vec", numCategories=3)
+    out = enc.transform(clean).collect()
+    # dropLast=True (Spark default): last category is all-zeros
+    assert [r["vec"] for r in out] == [[1.0, 0.0], [0.0, 1.0], [0.0, 0.0]]
+    full = OneHotEncoder(inputCol="i", outputCol="vec", numCategories=3,
+                         dropLast=False).transform(clean).collect()
+    assert full[2]["vec"] == [0.0, 0.0, 1.0]
+
+    # invalid data (null / out-of-range): error by default, 'keep' widens
+    # by an extra category (all-zeros under dropLast, Spark semantics)
+    dirty = DataFrame.fromRows([{"i": 0.0}, {"i": None}, {"i": 9.0}])
+    with pytest.raises(Exception, match="invalid category"):
+        enc.transform(dirty).collect()
+    kept = OneHotEncoder(inputCol="i", outputCol="vec", numCategories=3,
+                         handleInvalid="keep").transform(dirty).collect()
+    assert [r["vec"] for r in kept] == [[1.0, 0.0, 0.0], [0.0, 0.0, 0.0],
+                                        [0.0, 0.0, 0.0]]
+    kept_full = OneHotEncoder(
+        inputCol="i", outputCol="vec", numCategories=3, dropLast=False,
+        handleInvalid="keep").transform(dirty).collect()
+    assert kept_full[1]["vec"] == [0.0, 0.0, 0.0, 1.0]
+    # fractional indices are a wiring mistake — always rejected
+    with pytest.raises(Exception, match="not integral"):
+        OneHotEncoder(inputCol="i", outputCol="vec", numCategories=3,
+                      handleInvalid="keep").transform(
+            DataFrame.fromRows([{"i": 1.7}])).collect()
+    enc.save(str(tmp_path / "ohe"))
+    assert load(str(tmp_path / "ohe")).getNumCategories() == 3
+
+
+def test_vector_assembler_null_vector_cell_never_kept():
+    """A null VECTOR cell has unknown width: 'keep' must raise, not emit
+    a ragged single-NaN row."""
+    from sparkdl_tpu.ml import VectorAssembler
+
+    import pyarrow as pa
+
+    rows = [{"v": [1.0, 2.0], "b": 1.0}, {"v": None, "b": 2.0}]
+    schema = pa.schema([pa.field("v", pa.list_(pa.float64())),
+                        pa.field("b", pa.float64())])
+    df = DataFrame.fromRows(rows, schema=schema)
+    va = VectorAssembler(inputCols=["v", "b"], outputCol="f",
+                         handleInvalid="keep")
+    with pytest.raises(Exception, match="vector column"):
+        va.transform(df).collect()
+
+
+def test_assembler_in_flagship_pipeline(rng):
+    """Mixed tabular + model features assembled for the downstream
+    learner — the Spark workflow shape around the featurizer."""
+    from sparkdl_tpu.ml import VectorAssembler
+
+    x = rng.normal(size=(60, 2)).astype(np.float32)
+    extra = rng.normal(size=60).astype(np.float32)
+    y = (x[:, 0] + extra > 0).astype(int)
+    df = DataFrame.fromRows(
+        [{"emb": x[i].tolist(), "extra": float(extra[i]),
+          "label": int(y[i])} for i in range(60)], numPartitions=2)
+    pipe = Pipeline(stages=[
+        VectorAssembler(inputCols=["emb", "extra"], outputCol="features"),
+        LogisticRegression(maxIter=100),
+    ])
+    out = pipe.fit(df).transform(df).collect()
+    acc = np.mean([r["prediction"] == r["label"] for r in out])
+    assert acc >= 0.9

@@ -152,6 +152,11 @@ def test_cross_validator_over_keras_estimator(rng, tmp_path):
 
     from sparkdl_tpu.ml import KerasImageFileEstimator
 
+    # keras init is otherwise unseeded: an (occasionally) lucky random
+    # init let the deliberately-under-trained map win a fold and flip
+    # bestIndex — seed it so the selection outcome is deterministic
+    keras.utils.set_random_seed(0)
+
     rows = []
     for i in range(24):
         label = i % 2
