@@ -237,7 +237,18 @@ class VectorAssembler(Transformer, Params, ParamsOnlyPersistence):
             or pa.types.is_large_list(dataset.schema.field(c).type)}
 
         if policy == "skip":
-            dataset = dataset.dropna(subset=cols)
+            # element-level too: a [1.0, None] vector cell is invalid data
+            # even though the cell itself is non-null
+            def row_valid(*vals) -> bool:
+                for v in vals:
+                    if v is None:
+                        return False
+                    if isinstance(v, (list, tuple)) and any(
+                            x is None for x in v):
+                        return False
+                return True
+
+            dataset = dataset.filter(row_valid, inputCols=cols)
 
         def assemble(*vals):
             out: List[float] = []
@@ -252,14 +263,26 @@ class VectorAssembler(Transformer, Params, ParamsOnlyPersistence):
                            if c in vector_cols else
                            "(handleInvalid='error'; use 'skip' or 'keep')"))
                 if isinstance(v, (list, tuple)):
-                    out.extend(float(x) for x in v)
+                    for x in v:
+                        if x is None:
+                            # element width IS known here: keep → NaN
+                            if policy == "keep":
+                                out.append(float("nan"))
+                                continue
+                            raise ValueError(
+                                f"NULL element inside vector column "
+                                f"{c!r} (handleInvalid='error'; use "
+                                "'skip' or 'keep')")
+                        out.append(float(x))
                 else:
                     out.append(float(v))
             return out
 
+        # float64 like Spark's double vectors: float32 would silently
+        # round int64 ids above 2^24 and truncate float64 inputs
         return dataset.withColumn(self.getOutputCol(), assemble,
                                   inputCols=cols,
-                                  outputType=pa.list_(pa.float32()))
+                                  outputType=pa.list_(pa.float64()))
 
 
 class OneHotEncoder(Transformer, _IndexerParams, ParamsOnlyPersistence):
@@ -314,8 +337,11 @@ class OneHotEncoder(Transformer, _IndexerParams, ParamsOnlyPersistence):
         # drops one. keep+dropLast: invalid encodes as all-zeros.
         width = n + (1 if keep else 0) - (1 if self.getDropLast() else 0)
 
+        import math
+
         def encode(v):
-            invalid = v is None
+            invalid = v is None or (isinstance(v, float)
+                                    and not math.isfinite(v))
             i = -1
             if not invalid:
                 i = int(v)

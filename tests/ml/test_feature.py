@@ -200,3 +200,41 @@ def test_assembler_in_flagship_pipeline(rng):
     out = pipe.fit(df).transform(df).collect()
     acc = np.mean([r["prediction"] == r["label"] for r in out])
     assert acc >= 0.9
+
+
+def test_assembler_null_element_and_precision():
+    from sparkdl_tpu.ml import VectorAssembler
+
+    import pyarrow as pa
+
+    rows = [{"v": [1.0, None], "b": 2.0}, {"v": [3.0, 4.0], "b": 5.0}]
+    schema = pa.schema([pa.field("v", pa.list_(pa.float64())),
+                        pa.field("b", pa.float64())])
+    df = DataFrame.fromRows(rows, schema=schema)
+    with pytest.raises(Exception, match="element"):
+        VectorAssembler(inputCols=["v", "b"], outputCol="f").transform(df) \
+            .collect()
+    kept = VectorAssembler(inputCols=["v", "b"], outputCol="f",
+                           handleInvalid="keep").transform(df).collect()
+    assert np.isnan(kept[0]["f"][1]) and kept[0]["f"][0] == 1.0
+    skipped = VectorAssembler(inputCols=["v", "b"], outputCol="f",
+                              handleInvalid="skip").transform(df).collect()
+    assert len(skipped) == 1 and skipped[0]["f"] == [3.0, 4.0, 5.0]
+    # float64 output: int64 ids above 2^24 survive exactly
+    big = DataFrame.fromRows([{"id": 16777217, "x": 0.5}])
+    out = VectorAssembler(inputCols=["id", "x"], outputCol="f") \
+        .transform(big).collect()
+    assert out[0]["f"][0] == 16777217.0
+
+
+def test_one_hot_encoder_nonfinite():
+    from sparkdl_tpu.ml import OneHotEncoder
+
+    df = DataFrame.fromRows([{"i": float("nan")}, {"i": 0.0}])
+    with pytest.raises(Exception, match="invalid category"):
+        OneHotEncoder(inputCol="i", outputCol="v",
+                      numCategories=3).transform(df).collect()
+    kept = OneHotEncoder(inputCol="i", outputCol="v", numCategories=3,
+                         handleInvalid="keep").transform(df).collect()
+    assert kept[0]["v"] == [0.0, 0.0, 0.0]  # NaN -> invalid category
+    assert kept[1]["v"] == [1.0, 0.0, 0.0]
