@@ -23,9 +23,15 @@ from sparkdl_tpu.ml.estimator import KerasImageFileEstimator, KerasImageFileMode
 from sparkdl_tpu.ml.feature import (
     IndexToString,
     OneHotEncoder,
+    StandardScaler,
+    StandardScalerModel,
     StringIndexer,
     StringIndexerModel,
     VectorAssembler,
+)
+from sparkdl_tpu.ml.regression import (
+    LinearRegression,
+    LinearRegressionModel,
 )
 from sparkdl_tpu.ml.evaluation import (
     BinaryClassificationEvaluator,
@@ -70,8 +76,12 @@ __all__ = [
     "StringIndexerModel",
     "KerasImageFileTransformer",
     "KerasTransformer",
+    "LinearRegression",
+    "LinearRegressionModel",
     "LogisticRegression",
     "LogisticRegressionModel",
+    "StandardScaler",
+    "StandardScalerModel",
     "Model",
     "OneHotEncoder",
     "Pipeline",

@@ -214,9 +214,10 @@ class LogisticRegression(Estimator, _HasClassifierCols,
             raise ValueError("labels must be non-negative class indices")
         w = None
         if weight_col is not None:
-            w = np.asarray(weights, np.float32)
-            if (w < 0).any():
-                raise ValueError(f"{weight_col!r} holds negative weights")
+            from sparkdl_tpu.ml.linear_utils import validate_weights
+
+            w = validate_weights(np.asarray(weights, np.float32),
+                                 weight_col)
         return x, y, int(y.max()) + 1, w
 
     def _fit(self, dataset) -> "LogisticRegressionModel":
@@ -235,18 +236,9 @@ class LogisticRegression(Estimator, _HasClassifierCols,
         # coefficients on the original scale.
         std = None
         if self.getStandardization() and len(x) > 1:
-            if sample_w is None:
-                std = x.std(axis=0, ddof=1)
-            else:
-                # weighted std (Spark's weighted summarizer): with integer
-                # weights this equals the duplicated sample's ddof=1 std,
-                # keeping weight-2 == duplicate-row exact under regParam
-                wsum = float(sample_w.sum())
-                mu = (sample_w[:, None] * x).sum(axis=0) / wsum
-                var = ((sample_w[:, None] * (x - mu) ** 2).sum(axis=0)
-                       / max(wsum - 1.0, 1e-12))
-                std = np.sqrt(var)
-            std = np.where(std > 0, std, 1.0).astype(np.float32)
+            from sparkdl_tpu.ml.linear_utils import weighted_feature_std
+
+            std = weighted_feature_std(x, sample_w).astype(np.float32)
             x = x / std
         w, b, iters = _fit_softmax(
             x, y, n_classes, max_iter=self.getMaxIter(),

@@ -367,6 +367,135 @@ class OneHotEncoder(Transformer, _IndexerParams, ParamsOnlyPersistence):
                                   outputType=pa.list_(pa.float32()))
 
 
+class StandardScaler(Estimator, _IndexerParams, ParamsOnlyPersistence):
+    """Standardize a vector column (Spark semantics: ``withStd=True``
+    divides by the UNBIASED per-dimension std, ``withMean=False`` by
+    default — centering densifies sparse data, so Spark makes it
+    opt-in)."""
+
+    withMean = Param("StandardScaler", "withMean",
+                     "center by the mean before scaling (Spark default "
+                     "False)", typeConverter=TypeConverters.toBoolean)
+    withStd = Param("StandardScaler", "withStd",
+                    "scale to unit std (Spark default True)",
+                    typeConverter=TypeConverters.toBoolean)
+
+    @keyword_only
+    def __init__(self, *, inputCol: Optional[str] = None,
+                 outputCol: Optional[str] = None,
+                 withMean: bool = False, withStd: bool = True) -> None:
+        super().__init__()
+        self._setDefault(withMean=False, withStd=True)
+        self._set(**self._input_kwargs)
+
+    def getWithMean(self):
+        return self.getOrDefault(self.withMean)
+
+    def getWithStd(self):
+        return self.getOrDefault(self.withStd)
+
+    def _fit(self, dataset) -> "StandardScalerModel":
+        import numpy as np
+
+        col = self.getInputCol()
+        # streaming Welford merge per dimension (bounded memory, no
+        # catastrophic cancellation — same recipe as RegressionEvaluator)
+        n = 0
+        mean = None
+        m2 = None
+        for batch in dataset.select(col).streamPartitions():
+            rows = [r for r in batch.column(0).to_pylist() if r is not None]
+            if not rows:
+                continue
+            x = np.asarray(rows, np.float64)
+            nb = len(x)
+            batch_mean = x.mean(axis=0)
+            batch_m2 = ((x - batch_mean) ** 2).sum(axis=0)
+            if mean is None:
+                mean, m2, n = batch_mean, batch_m2, nb
+                continue
+            if batch_mean.shape != mean.shape:
+                # numpy would silently broadcast mismatched widths into
+                # garbage statistics
+                raise ValueError(
+                    f"{col!r} holds vectors of inconsistent widths: "
+                    f"{mean.shape[0]} vs {batch_mean.shape[0]}")
+            delta = batch_mean - mean
+            total = n + nb
+            m2 = m2 + batch_m2 + delta ** 2 * n * nb / total
+            mean = mean + delta * nb / total
+            n = total
+        if n == 0:
+            raise ValueError(f"no non-null rows in {col!r} to fit on")
+        std = np.sqrt(m2 / max(n - 1, 1))
+        std = np.where(std > 0, std, 1.0)
+        model = StandardScalerModel(
+            inputCol=col, outputCol=self.getOutputCol(),
+            withMean=self.getWithMean(), withStd=self.getWithStd(),
+            mean=mean.tolist(), std=std.tolist())
+        model._set_parent(self)
+        return model
+
+
+class StandardScalerModel(Model, _IndexerParams, ParamsOnlyPersistence):
+    """Fitted scaler: per-dimension (x - mean?) / std?."""
+
+    withMean = Param("StandardScalerModel", "withMean", "center first",
+                     typeConverter=TypeConverters.toBoolean)
+    withStd = Param("StandardScalerModel", "withStd", "scale to unit std",
+                    typeConverter=TypeConverters.toBoolean)
+    mean = Param("StandardScalerModel", "mean", "per-dimension mean",
+                 typeConverter=TypeConverters.toListFloat)
+    std = Param("StandardScalerModel", "std", "per-dimension unbiased std",
+                typeConverter=TypeConverters.toListFloat)
+
+    @keyword_only
+    def __init__(self, *, inputCol: Optional[str] = None,
+                 outputCol: Optional[str] = None,
+                 withMean: bool = False, withStd: bool = True,
+                 mean: Optional[List[float]] = None,
+                 std: Optional[List[float]] = None) -> None:
+        super().__init__()
+        self._setDefault(withMean=False, withStd=True)
+        self._set(**self._input_kwargs)
+
+    def getMean(self):
+        import numpy as np
+
+        return np.asarray(self.getOrDefault(self.mean), np.float64)
+
+    def getStd(self):
+        import numpy as np
+
+        return np.asarray(self.getOrDefault(self.std), np.float64)
+
+    def _transform(self, dataset):
+        import numpy as np
+        import pyarrow as pa
+
+        mean = self.getMean()
+        std = self.getStd()
+        center = self.getOrDefault(self.withMean)
+        scale = self.getOrDefault(self.withStd)
+
+        def scale_row(v):
+            if v is None:
+                return None
+            x = np.asarray(v, np.float64)
+            if x.shape != mean.shape:
+                raise ValueError(
+                    f"row width {x.shape} != fitted width {mean.shape}")
+            if center:
+                x = x - mean
+            if scale:
+                x = x / std
+            return x.tolist()
+
+        return dataset.withColumn(self.getOutputCol(), scale_row,
+                                  inputCols=[self.getInputCol()],
+                                  outputType=pa.list_(pa.float64()))
+
+
 class IndexToString(Transformer, _IndexerParams, ParamsOnlyPersistence):
     """Inverse mapping: float index column → label string column."""
 

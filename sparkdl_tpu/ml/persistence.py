@@ -53,6 +53,10 @@ _LOADABLE = {
     "sparkdl_tpu.ml.feature.IndexToString",
     "sparkdl_tpu.ml.feature.VectorAssembler",
     "sparkdl_tpu.ml.feature.OneHotEncoder",
+    "sparkdl_tpu.ml.feature.StandardScaler",
+    "sparkdl_tpu.ml.feature.StandardScalerModel",
+    "sparkdl_tpu.ml.regression.LinearRegression",
+    "sparkdl_tpu.ml.regression.LinearRegressionModel",
     "sparkdl_tpu.ml.evaluation.MulticlassClassificationEvaluator",
     "sparkdl_tpu.ml.evaluation.RegressionEvaluator",
     "sparkdl_tpu.ml.evaluation.BinaryClassificationEvaluator",

@@ -1,0 +1,178 @@
+"""LinearRegression + StandardScaler — the regression-side consumers of
+the featurizer. Oracles: the exact closed-form ridge solution computed
+independently with numpy, weight==duplication equivalence, Spark's
+standardized-penalty semantics, and TVS model selection driven by
+RegressionEvaluator."""
+
+import numpy as np
+import pytest
+
+from sparkdl_tpu.engine.dataframe import DataFrame
+from sparkdl_tpu.ml import (
+    LinearRegression,
+    LinearRegressionModel,
+    ParamGridBuilder,
+    Pipeline,
+    RegressionEvaluator,
+    StandardScaler,
+    StandardScalerModel,
+    TrainValidationSplit,
+    load,
+)
+
+
+def _frame(x, y, w=None):
+    rows = []
+    for i in range(len(x)):
+        r = {"features": x[i].tolist(), "label": float(y[i])}
+        if w is not None:
+            r["w"] = float(w[i])
+        rows.append(r)
+    return DataFrame.fromRows(rows, numPartitions=2)
+
+
+def _numpy_ridge(x, y, reg, std=None):
+    """Independent closed-form oracle: centered ridge in (optionally)
+    scaled space, coefficients unscaled back."""
+    xs = x / std if std is not None else x
+    n = len(x)
+    xm, ym = xs.mean(axis=0), y.mean()
+    xc, yc = xs - xm, y - ym
+    beta = np.linalg.solve(xc.T @ xc / n + reg * np.eye(x.shape[1]),
+                           xc.T @ yc / n)
+    b = ym - xm @ beta
+    if std is not None:
+        beta = beta / std
+    return beta, b
+
+
+def test_matches_closed_form_oracle(rng):
+    x = rng.normal(size=(50, 4)).astype(np.float64)
+    beta_true = np.asarray([1.5, -2.0, 0.5, 0.0])
+    y = x @ beta_true + 3.0 + rng.normal(size=50) * 0.05
+    # reg=0: exact OLS regardless of standardization
+    model = LinearRegression().fit(_frame(x, y))
+    want_beta, want_b = _numpy_ridge(x, y, 0.0)
+    np.testing.assert_allclose(model.coefficients, want_beta,
+                               rtol=1e-4, atol=1e-5)
+    assert model.intercept == pytest.approx(want_b, rel=1e-4)
+    # reg>0 with standardization: penalty applies in unit-std space
+    std = x.std(axis=0, ddof=1)
+    reg_model = LinearRegression(regParam=0.5).fit(_frame(x, y))
+    want_beta, want_b = _numpy_ridge(x, y, 0.5, std=std)
+    np.testing.assert_allclose(reg_model.coefficients, want_beta,
+                               rtol=1e-4, atol=1e-5)
+    # reg>0 without standardization differs
+    raw_model = LinearRegression(regParam=0.5,
+                                 standardization=False).fit(_frame(x, y))
+    want_raw, _ = _numpy_ridge(x, y, 0.5)
+    np.testing.assert_allclose(raw_model.coefficients, want_raw,
+                               rtol=1e-4, atol=1e-5)
+    # prediction column
+    out = model.transform(_frame(x, y)).collect()
+    preds = np.asarray([r["prediction"] for r in out])
+    np.testing.assert_allclose(preds, x @ model.coefficients
+                               + model.intercept, rtol=1e-6)
+
+
+def test_weight_equals_duplication(rng):
+    x = rng.normal(size=(30, 3)).astype(np.float64)
+    y = x[:, 0] * 2 + rng.normal(size=30) * 0.1
+    w = np.where(np.arange(30) < 10, 2.0, 1.0)
+    dup_x = np.concatenate([x, x[:10]])
+    dup_y = np.concatenate([y, y[:10]])
+    m_w = LinearRegression(regParam=0.2, weightCol="w").fit(_frame(x, y, w))
+    m_d = LinearRegression(regParam=0.2).fit(_frame(dup_x, dup_y))
+    np.testing.assert_allclose(m_w.coefficients, m_d.coefficients,
+                               rtol=1e-4, atol=1e-6)
+    assert m_w.intercept == pytest.approx(m_d.intercept, abs=1e-5)
+
+
+def test_persistence_and_nulls(rng, tmp_path):
+    x = rng.normal(size=(20, 2))
+    y = x[:, 0] + 1.0
+    model = LinearRegression().fit(_frame(x, y))
+    model.save(str(tmp_path / "lrm"))
+    loaded = load(str(tmp_path / "lrm"))
+    assert isinstance(loaded, LinearRegressionModel)
+    np.testing.assert_allclose(loaded.coefficients, model.coefficients)
+    nulls = DataFrame.fromRows([{"features": None, "label": 0.0}])
+    assert loaded.transform(nulls).collect()[0]["prediction"] is None
+    est = LinearRegression(regParam=0.3, standardization=False)
+    est.save(str(tmp_path / "lr"))
+    re = load(str(tmp_path / "lr"))
+    assert re.getRegParam() == pytest.approx(0.3)
+    assert not re.getStandardization()
+
+
+def test_tvs_selects_over_linear_regression(rng):
+    """The tuning layer's regression half, end to end: TVS +
+    RegressionEvaluator pick the sane regParam over a crippling one."""
+    x = rng.normal(size=(80, 3)).astype(np.float64)
+    y = x @ np.asarray([1.0, -1.0, 0.5]) + rng.normal(size=80) * 0.1
+    lr = LinearRegression()
+    grid = ParamGridBuilder().addGrid(lr.regParam, [0.0, 1000.0]).build()
+    tvs = TrainValidationSplit(
+        estimator=lr, estimatorParamMaps=grid,
+        evaluator=RegressionEvaluator(metricName="rmse"),
+        trainRatio=0.7, seed=3)
+    model = tvs.fit(_frame(x, y))
+    assert model.bestIndex == 0
+    assert model.validationMetrics[0] < model.validationMetrics[1]
+
+
+def test_standard_scaler(rng, tmp_path):
+    x = rng.normal(size=(40, 3)) * np.asarray([10.0, 0.1, 1.0]) + 5.0
+    df = DataFrame.fromRows([{"v": x[i].tolist()} for i in range(40)],
+                            numPartitions=3)
+    # Spark defaults: withStd only
+    model = StandardScaler(inputCol="v", outputCol="s").fit(df)
+    np.testing.assert_allclose(model.getStd(), x.std(axis=0, ddof=1),
+                               rtol=1e-9)
+    out = np.asarray([r["s"] for r in model.transform(df).collect()])
+    np.testing.assert_allclose(out, x / x.std(axis=0, ddof=1), rtol=1e-9)
+    # withMean centers too
+    full = StandardScaler(inputCol="v", outputCol="s", withMean=True,
+                          withStd=True).fit(df)
+    out = np.asarray([r["s"] for r in full.transform(df).collect()])
+    np.testing.assert_allclose(out.mean(axis=0), 0.0, atol=1e-9)
+    np.testing.assert_allclose(out.std(axis=0, ddof=1), 1.0, rtol=1e-9)
+    # persistence
+    full.save(str(tmp_path / "ssm"))
+    loaded = load(str(tmp_path / "ssm"))
+    assert isinstance(loaded, StandardScalerModel)
+    np.testing.assert_allclose(loaded.getMean(), full.getMean())
+    # pipeline: scaler feeding the regressor
+    y = (x[:, 0] / 10.0) + rng.normal(size=40) * 0.05
+    pdf = DataFrame.fromRows(
+        [{"v": x[i].tolist(), "label": float(y[i])} for i in range(40)],
+        numPartitions=2)
+    pipe = Pipeline(stages=[
+        StandardScaler(inputCol="v", outputCol="features", withMean=True),
+        LinearRegression(),
+    ])
+    scored = pipe.fit(pdf).transform(pdf).collect()
+    rmse = np.sqrt(np.mean([(r["prediction"] - r["label"]) ** 2
+                            for r in scored]))
+    assert rmse < 0.1
+
+
+def test_rank_deficient_min_norm(rng):
+    """n < d (transfer-learning shape): fit must return the min-norm
+    solution, not NaN (the normal-equations solve would)."""
+    x = rng.normal(size=(5, 12)).astype(np.float64)
+    y = x[:, 0] * 2.0
+    model = LinearRegression(regParam=0.0).fit(_frame(x, y))
+    assert np.isfinite(model.coefficients).all()
+    preds = np.asarray([r["prediction"] for r in
+                        model.transform(_frame(x, y)).collect()])
+    np.testing.assert_allclose(preds, y, atol=1e-8)  # interpolates
+
+
+def test_scaler_rejects_inconsistent_widths():
+    from sparkdl_tpu.ml import StandardScaler
+
+    df = DataFrame.fromRows([{"v": [1.0]}] * 4 + [{"v": [1.0, 2.0]}] * 4,
+                            numPartitions=2)
+    with pytest.raises(ValueError, match="widths"):
+        StandardScaler(inputCol="v", outputCol="s").fit(df)
