@@ -126,6 +126,22 @@ _INGESTED_MODELS: Dict[str, ModelSpec] = {
         "EfficientNetV2B0", None, (224, 224), preprocess_identity, 1280),
     "ConvNeXtTiny": ModelSpec(
         "ConvNeXtTiny", None, (224, 224), preprocess_identity, 768),
+    # size variants of the proven families (every family has a
+    # keras-forward oracle test in tests/models/test_keras_oracle.py;
+    # per-name dims validate against keras output_shape in
+    # tests/ml/test_named_image.py)
+    "DenseNet169": ModelSpec(
+        "DenseNet169", None, (224, 224), preprocess_torch_mode, 1664),
+    "DenseNet201": ModelSpec(
+        "DenseNet201", None, (224, 224), preprocess_torch_mode, 1920),
+    "ResNet101V2": ModelSpec(
+        "ResNet101V2", None, (224, 224), preprocess_tf_mode, 2048),
+    "ResNet152V2": ModelSpec(
+        "ResNet152V2", None, (224, 224), preprocess_tf_mode, 2048),
+    "EfficientNetB1": ModelSpec(
+        "EfficientNetB1", None, (240, 240), preprocess_identity, 1280),
+    "MobileNetV3Large": ModelSpec(
+        "MobileNetV3Large", None, (224, 224), preprocess_identity, 960),
 }
 
 _INGESTED_BUILDERS = {
@@ -136,6 +152,12 @@ _INGESTED_BUILDERS = {
     "ResNet50V2": ("resnet_v2", "ResNet50V2"),
     "EfficientNetV2B0": ("efficientnet_v2", "EfficientNetV2B0"),
     "ConvNeXtTiny": ("convnext", "ConvNeXtTiny"),
+    "DenseNet169": ("densenet", "DenseNet169"),
+    "DenseNet201": ("densenet", "DenseNet201"),
+    "ResNet101V2": ("resnet_v2", "ResNet101V2"),
+    "ResNet152V2": ("resnet_v2", "ResNet152V2"),
+    "EfficientNetB1": ("efficientnet", "EfficientNetB1"),
+    "MobileNetV3Large": (None, "MobileNetV3Large"),
 }
 
 

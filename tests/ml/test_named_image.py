@@ -260,3 +260,30 @@ def test_ingested_bf16_saves_full_precision_weights(rng, tmp_path):
         numPartitions=1)
     out = t32.transform(df).collect()
     assert np.asarray(out[0]["f"], np.float32).shape == (576,)
+
+
+def test_r5_zoo_size_variants_registered():
+    """r5 zoo widening: size variants of the oracle-proven ingestion
+    families. Every name's feature_dim is validated against the KERAS
+    model's own headless pooled output width (construction only, no
+    forward — a registry-vs-registry comparison would be tautological);
+    one representative (the smallest) additionally builds and runs
+    end-to-end. Family-level walker correctness is pinned by the oracle
+    tests in tests/models/test_keras_oracle.py."""
+    pytest.importorskip("keras")
+    from sparkdl_tpu.models import registry
+
+    for name in ("DenseNet169", "DenseNet201", "ResNet101V2",
+                 "ResNet152V2", "EfficientNetB1", "MobileNetV3Large"):
+        assert name in registry.SUPPORTED_MODEL_NAMES
+        spec = registry.get_model_spec(name)
+        h, w = spec.input_size
+        ctor = registry._resolve_keras_ctor(name)
+        assert ctor.__name__ == name
+        kmodel = ctor(weights=None, include_top=False, pooling="avg",
+                      input_shape=(h, w, 3))
+        assert kmodel.output_shape[-1] == spec.feature_dim, name
+    mf = registry.build_featurizer("MobileNetV3Large", weights="random")
+    out = mf.apply_fn(mf.variables,
+                      np.zeros((1, 224, 224, 3), np.float32))
+    assert out.shape == (1, 960)
