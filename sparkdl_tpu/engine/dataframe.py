@@ -598,6 +598,81 @@ class DataFrame:
                 raise KeyError(f"No such column: {c!r}")
         return GroupedData(self, list(cols))
 
+    def join(self, other: "DataFrame", on: Union[str, Sequence[str]],
+             how: str = "inner") -> "DataFrame":
+        """Equi-join on key column(s) (Spark's ``df.join(other, on, how)``;
+        ``inner`` or ``left``).
+
+        Materializing hash join sized to this framework's workloads:
+        the RIGHT side builds the hash table (metadata/label frames —
+        keep the small side on the right), the left streams through it.
+        Key columns appear once (Spark's USING semantics); other
+        name collisions raise rather than silently disambiguate.
+        Row multiplicity matches SQL: matching left×right pairs multiply.
+        """
+        from collections import defaultdict
+
+        keys = [on] if isinstance(on, str) else list(on)
+        if how not in ("inner", "left"):
+            raise ValueError(f"how must be 'inner' or 'left', got {how!r}")
+        for k in keys:
+            if k not in self.columns:
+                raise KeyError(f"No such column on left: {k!r}")
+            if k not in other.columns:
+                raise KeyError(f"No such column on right: {k!r}")
+        left_other = [c for c in self.columns if c not in keys]
+        right_other = [c for c in other.columns if c not in keys]
+        clash = set(left_other) & set(right_other)
+        if clash:
+            raise ValueError(
+                f"join would duplicate columns {sorted(clash)}; rename "
+                "one side first (withColumnRenamed)")
+
+        # build side: the right frame, fully materialized once. Keys are
+        # frozen (nested list/struct/binary keys hash like distinct()'s).
+        right_table = other.toArrow()
+        build: Dict[Any, List[Dict[str, Any]]] = defaultdict(list)
+        for r in right_table.to_pylist():
+            key = tuple(_freeze_value(r[k]) for k in keys)
+            if any(v is None for v in key):
+                continue  # SQL: null keys never match
+            build[key].append({c: r[c] for c in right_other})
+
+        # probe side streams per materialized partition; the output uses
+        # an EXPLICIT schema (actual left types + right types) in one
+        # fixed column order, so dtypes survive instead of being
+        # re-inferred from Python values (an all-null right column under
+        # a left join would otherwise degrade to pa.null()).
+        left_batches = self._materialize()
+        left_schema = (pa.unify_schemas([b.schema for b in left_batches],
+                                        promote_options="permissive")
+                       if left_batches else self._schema)
+        joined_schema = pa.schema(
+            [left_schema.field(name) for name in left_schema.names]
+            + [right_table.schema.field(c) for c in right_other])
+
+        out_tables: List[pa.Table] = []
+        for batch in left_batches:
+            out_rows: List[Dict[str, Any]] = []
+            for r in batch.to_pylist():
+                key = tuple(_freeze_value(r[k]) for k in keys)
+                matches = ([] if any(v is None for v in key)
+                           else build.get(key, []))
+                if matches:
+                    for m in matches:
+                        out_rows.append({**r, **m})
+                elif how == "left":
+                    out_rows.append(
+                        {**r, **{c: None for c in right_other}})
+            if out_rows:
+                out_tables.append(
+                    pa.Table.from_pylist(out_rows, schema=joined_schema))
+        if not out_tables:
+            empty = pa.Table.from_pylist([], schema=joined_schema)
+            return DataFrame.fromArrow(empty, numPartitions=1)
+        return DataFrame.fromArrow(pa.concat_tables(out_tables),
+                                   numPartitions=max(1, self.numPartitions))
+
     def distinct(self) -> "DataFrame":
         """Deduplicated rows (Spark's distinct; materializing, order of
         first occurrence).

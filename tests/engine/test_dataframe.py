@@ -440,3 +440,82 @@ def test_distinct_nested_columns():
     rows = [{"s": {"k": [1, 2]}}, {"s": {"k": [1, 2]}}, {"s": {"k": [3]}}]
     df = DataFrame.fromRows(rows, numPartitions=2)
     assert len(df.distinct().collect()) == 2
+
+
+def test_join_inner_left_and_guards():
+    from sparkdl_tpu.engine.dataframe import DataFrame
+
+    left = DataFrame.fromRows(
+        [{"id": 1, "x": "a"}, {"id": 2, "x": "b"}, {"id": 2, "x": "c"},
+         {"id": 3, "x": "d"}, {"id": None, "x": "e"}], numPartitions=2)
+    right = DataFrame.fromRows(
+        [{"id": 1, "y": 10}, {"id": 2, "y": 20}, {"id": 2, "y": 21},
+         {"id": 9, "y": 90}, {"id": None, "y": 99}], numPartitions=2)
+
+    inner = left.join(right, on="id").collect()
+    # id=1 -> 1 pair; id=2 -> 2 left x 2 right = 4 pairs; nulls never match
+    assert len(inner) == 5
+    assert {(r["id"], r["x"], r["y"]) for r in inner} == {
+        (1, "a", 10), (2, "b", 20), (2, "b", 21), (2, "c", 20),
+        (2, "c", 21)}
+    assert set(inner[0]) == {"id", "x", "y"}  # key appears once
+
+    lj = left.join(right, on="id", how="left").collect()
+    assert len(lj) == 7  # 5 matches + id=3 + null-key row
+    unmatched = [r for r in lj if r["y"] is None]
+    assert {r["x"] for r in unmatched} == {"d", "e"}
+
+    with pytest.raises(ValueError, match="duplicate columns"):
+        left.join(DataFrame.fromRows([{"id": 1, "x": "z"}]), on="id")
+    with pytest.raises(KeyError, match="right"):
+        left.join(DataFrame.fromRows([{"k": 1}]), on="id")
+    with pytest.raises(ValueError, match="how"):
+        left.join(right, on="id", how="outer")
+    # empty result keeps the joined schema
+    empty = DataFrame.fromRows([{"id": 77, "x": "q"}]).join(right, on="id")
+    assert empty.count() == 0
+    assert empty.columns == ["id", "x", "y"]
+
+
+def test_join_multi_key():
+    from sparkdl_tpu.engine.dataframe import DataFrame
+
+    left = DataFrame.fromRows([{"a": 1, "b": "u", "x": 1.0},
+                               {"a": 1, "b": "v", "x": 2.0}])
+    right = DataFrame.fromRows([{"a": 1, "b": "u", "y": 5.0}])
+    out = left.join(right, on=["a", "b"]).collect()
+    assert out == [{"a": 1, "b": "u", "x": 1.0, "y": 5.0}]
+
+
+def test_join_preserves_types_and_order():
+    import pyarrow as pa
+
+    from sparkdl_tpu.engine.dataframe import DataFrame
+
+    # key column NOT leftmost; unmatched left join must keep right's
+    # int64 dtype (all-null column would otherwise infer as null type)
+    left = DataFrame.fromRows([{"x": "a", "id": 7}], numPartitions=1)
+    right = DataFrame.fromRows([{"id": 1, "y": 10}], numPartitions=1)
+    out = left.join(right, on="id", how="left")
+    assert out.columns == ["x", "id", "y"]
+    table = out.toArrow()
+    assert table.schema.field("y").type == pa.int64()
+    assert out.collect() == [{"x": "a", "id": 7, "y": None}]
+    # matched and unmatched results share one column order
+    both = DataFrame.fromRows([{"x": "a", "id": 1}]).join(right, on="id")
+    assert both.columns == ["x", "id", "y"]
+    # feature-vector columns survive a join with their list type
+    feats = DataFrame.fromColumns({"f": np.ones((2, 4), np.float32),
+                                   "id": np.asarray([1, 2])})
+    joined = feats.join(right, on="id").toArrow()
+    assert pa.types.is_fixed_size_list(joined.schema.field("f").type)
+
+
+def test_join_on_nested_key():
+    from sparkdl_tpu.engine.dataframe import DataFrame
+
+    left = DataFrame.fromRows([{"k": [1, 2], "x": "a"},
+                               {"k": [3], "x": "b"}])
+    right = DataFrame.fromRows([{"k": [1, 2], "y": 1.0}])
+    out = left.join(right, on="k").collect()
+    assert out == [{"k": [1, 2], "x": "a", "y": 1.0}]
