@@ -21,7 +21,11 @@ from sparkdl_tpu.ml.classification import (
 )
 from sparkdl_tpu.ml.estimator import KerasImageFileEstimator, KerasImageFileModel
 from sparkdl_tpu.ml.feature import (
+    Imputer,
+    ImputerModel,
     IndexToString,
+    MinMaxScaler,
+    MinMaxScalerModel,
     OneHotEncoder,
     StandardScaler,
     StandardScalerModel,
@@ -69,7 +73,11 @@ __all__ = [
     "RegressionEvaluator",
     "TrainValidationSplit",
     "TrainValidationSplitModel",
+    "Imputer",
+    "ImputerModel",
     "IndexToString",
+    "MinMaxScaler",
+    "MinMaxScalerModel",
     "KerasImageFileEstimator",
     "KerasImageFileModel",
     "StringIndexer",

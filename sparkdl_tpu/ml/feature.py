@@ -496,6 +496,239 @@ class StandardScalerModel(Model, _IndexerParams, ParamsOnlyPersistence):
                                   outputType=pa.list_(pa.float64()))
 
 
+class MinMaxScaler(Estimator, _IndexerParams, ParamsOnlyPersistence):
+    """Rescale a vector column to [min, max] per dimension (Spark
+    semantics: constant dimensions map to the midpoint)."""
+
+    min = Param("MinMaxScaler", "min", "lower bound (default 0.0)",
+                typeConverter=TypeConverters.toFloat)
+    max = Param("MinMaxScaler", "max", "upper bound (default 1.0)",
+                typeConverter=TypeConverters.toFloat)
+
+    @keyword_only
+    def __init__(self, *, inputCol: Optional[str] = None,
+                 outputCol: Optional[str] = None,
+                 min: float = 0.0, max: float = 1.0) -> None:
+        super().__init__()
+        self._setDefault(min=0.0, max=1.0)
+        self._set(**self._input_kwargs)
+
+    def _fit(self, dataset) -> "MinMaxScalerModel":
+        import numpy as np
+
+        lo_b = self.getOrDefault(self.min)
+        hi_b = self.getOrDefault(self.max)
+        if lo_b >= hi_b:
+            raise ValueError(f"min ({lo_b}) must be < max ({hi_b})")
+        col = self.getInputCol()
+        lo = hi = None
+        for batch in dataset.select(col).streamPartitions():
+            rows = [r for r in batch.column(0).to_pylist() if r is not None]
+            if not rows:
+                continue
+            x = np.asarray(rows, np.float64)
+            if not np.isfinite(x).all():
+                # NaN would poison min/max and the transform would then
+                # silently midpoint the whole dimension — demand finite
+                # inputs (run Imputer first)
+                raise ValueError(
+                    f"{col!r} holds NaN/Inf/null elements; impute before "
+                    "MinMaxScaler")
+            bl, bh = x.min(axis=0), x.max(axis=0)
+            if lo is None:
+                lo, hi = bl, bh
+                continue
+            if bl.shape != lo.shape:
+                raise ValueError(
+                    f"{col!r} holds vectors of inconsistent widths: "
+                    f"{lo.shape[0]} vs {bl.shape[0]}")
+            lo = np.minimum(lo, bl)
+            hi = np.maximum(hi, bh)
+        if lo is None:
+            raise ValueError(f"no non-null rows in {col!r} to fit on")
+        model = MinMaxScalerModel(
+            inputCol=col, outputCol=self.getOutputCol(),
+            min=lo_b, max=hi_b, originalMin=lo.tolist(),
+            originalMax=hi.tolist())
+        model._set_parent(self)
+        return model
+
+
+class MinMaxScalerModel(Model, _IndexerParams, ParamsOnlyPersistence):
+    """Fitted range scaler."""
+
+    min = Param("MinMaxScalerModel", "min", "lower bound",
+                typeConverter=TypeConverters.toFloat)
+    max = Param("MinMaxScalerModel", "max", "upper bound",
+                typeConverter=TypeConverters.toFloat)
+    originalMin = Param("MinMaxScalerModel", "originalMin",
+                        "fitted per-dimension minimum",
+                        typeConverter=TypeConverters.toListFloat)
+    originalMax = Param("MinMaxScalerModel", "originalMax",
+                        "fitted per-dimension maximum",
+                        typeConverter=TypeConverters.toListFloat)
+
+    @keyword_only
+    def __init__(self, *, inputCol: Optional[str] = None,
+                 outputCol: Optional[str] = None,
+                 min: float = 0.0, max: float = 1.0,
+                 originalMin: Optional[List[float]] = None,
+                 originalMax: Optional[List[float]] = None) -> None:
+        super().__init__()
+        self._setDefault(min=0.0, max=1.0)
+        self._set(**self._input_kwargs)
+
+    def _transform(self, dataset):
+        import numpy as np
+        import pyarrow as pa
+
+        lo = np.asarray(self.getOrDefault(self.originalMin), np.float64)
+        hi = np.asarray(self.getOrDefault(self.originalMax), np.float64)
+        out_lo = self.getOrDefault(self.min)
+        out_hi = self.getOrDefault(self.max)
+        span = hi - lo
+        mid = (out_lo + out_hi) / 2.0
+        # hoisted per-dimension affine: one multiply-add per row. Spark's
+        # rule for constant dimensions (span 0): map to the midpoint.
+        scale = np.where(span > 0, (out_hi - out_lo)
+                         / np.where(span > 0, span, 1.0), 0.0)
+        offset = np.where(span > 0, out_lo - lo * scale, mid)
+
+        def scale_row(v):
+            if v is None:
+                return None
+            x = np.asarray(v, np.float64)
+            if x.shape != lo.shape:
+                raise ValueError(
+                    f"row width {x.shape} != fitted width {lo.shape}")
+            return (x * scale + offset).tolist()
+
+        return dataset.withColumn(self.getOutputCol(), scale_row,
+                                  inputCols=[self.getInputCol()],
+                                  outputType=pa.list_(pa.float64()))
+
+
+class Imputer(Estimator, _IndexerParams, ParamsOnlyPersistence):
+    """Fill nulls (and NaNs) in a vector column with the per-dimension
+    mean or median (Spark's Imputer, single-column form)."""
+
+    strategy = Param(
+        "Imputer", "strategy", "'mean' or 'median'",
+        typeConverter=SparkDLTypeConverters.supportedNameConverter(
+            ["mean", "median"]))
+
+    @keyword_only
+    def __init__(self, *, inputCol: Optional[str] = None,
+                 outputCol: Optional[str] = None,
+                 strategy: str = "mean") -> None:
+        super().__init__()
+        self._setDefault(strategy="mean")
+        self._set(**self._input_kwargs)
+
+    def getStrategy(self):
+        return self.getOrDefault(self.strategy)
+
+    def _fit(self, dataset) -> "ImputerModel":
+        import numpy as np
+
+        col = self.getInputCol()
+        # Missing = null/NaN ONLY; +/-inf is a regular value (Spark
+        # semantics — an inf observation makes the mean inf, it is not
+        # silently dropped).
+        if self.getStrategy() == "mean":
+            # streaming per-dimension sum/count (bounded memory, like
+            # the scalers)
+            total = count = None
+            for batch in dataset.select(col).streamPartitions():
+                rows = [r for r in batch.column(0).to_pylist()
+                        if r is not None]
+                if not rows:
+                    continue
+                x = np.asarray([[np.nan if e is None else e for e in r]
+                                for r in rows], np.float64)
+                observed = ~np.isnan(x)
+                bsum = np.where(observed, x, 0.0).sum(axis=0)
+                bcnt = observed.sum(axis=0)
+                if total is None:
+                    total, count = bsum, bcnt
+                    continue
+                if bsum.shape != total.shape:
+                    raise ValueError(
+                        f"{col!r} holds vectors of inconsistent widths: "
+                        f"{total.shape[0]} vs {bsum.shape[0]}")
+                total = total + bsum
+                count = count + bcnt
+            if total is None:
+                raise ValueError(f"no non-null rows in {col!r} to fit on")
+            if (count == 0).any():
+                raise ValueError(
+                    f"{col!r} has dimensions with NO observed values; "
+                    "cannot impute")
+            fill = total / count
+        else:
+            # median needs the observed value set per dimension; Spark's
+            # percentile_approx(0.5) returns an ACTUAL element — the
+            # lower-middle for even counts — not numpy's midpoint average
+            rows = [r[col] for r in dataset.select(col).collect()
+                    if r[col] is not None]
+            if not rows:
+                raise ValueError(f"no non-null rows in {col!r} to fit on")
+            x = np.asarray([[np.nan if e is None else e for e in r]
+                            for r in rows], np.float64)
+            fill = np.empty(x.shape[1])
+            for j in range(x.shape[1]):
+                observed = np.sort(x[~np.isnan(x[:, j]), j])
+                if len(observed) == 0:
+                    raise ValueError(
+                        f"{col!r} has dimensions with NO observed "
+                        "values; cannot impute")
+                fill[j] = observed[(len(observed) - 1) // 2]
+        model = ImputerModel(inputCol=col, outputCol=self.getOutputCol(),
+                             surrogates=fill.tolist())
+        model._set_parent(self)
+        return model
+
+
+class ImputerModel(Model, _IndexerParams, ParamsOnlyPersistence):
+    """Fitted imputer: null rows and NaN elements fill with surrogates."""
+
+    surrogates = Param("ImputerModel", "surrogates",
+                       "per-dimension fill values",
+                       typeConverter=TypeConverters.toListFloat)
+
+    @keyword_only
+    def __init__(self, *, inputCol: Optional[str] = None,
+                 outputCol: Optional[str] = None,
+                 surrogates: Optional[List[float]] = None) -> None:
+        super().__init__()
+        self._set(**self._input_kwargs)
+
+    def getSurrogates(self):
+        import numpy as np
+
+        return np.asarray(self.getOrDefault(self.surrogates), np.float64)
+
+    def _transform(self, dataset):
+        import numpy as np
+        import pyarrow as pa
+
+        fill = self.getSurrogates()
+
+        def impute(v):
+            if v is None:
+                return fill.tolist()
+            x = np.asarray([np.nan if e is None else e for e in v],
+                           np.float64)
+            if x.shape != fill.shape:
+                raise ValueError(
+                    f"row width {x.shape} != fitted width {fill.shape}")
+            return np.where(np.isnan(x), fill, x).tolist()
+
+        return dataset.withColumn(self.getOutputCol(), impute,
+                                  inputCols=[self.getInputCol()],
+                                  outputType=pa.list_(pa.float64()))
+
+
 class IndexToString(Transformer, _IndexerParams, ParamsOnlyPersistence):
     """Inverse mapping: float index column → label string column."""
 

@@ -55,6 +55,10 @@ _LOADABLE = {
     "sparkdl_tpu.ml.feature.OneHotEncoder",
     "sparkdl_tpu.ml.feature.StandardScaler",
     "sparkdl_tpu.ml.feature.StandardScalerModel",
+    "sparkdl_tpu.ml.feature.MinMaxScaler",
+    "sparkdl_tpu.ml.feature.MinMaxScalerModel",
+    "sparkdl_tpu.ml.feature.Imputer",
+    "sparkdl_tpu.ml.feature.ImputerModel",
     "sparkdl_tpu.ml.regression.LinearRegression",
     "sparkdl_tpu.ml.regression.LinearRegressionModel",
     "sparkdl_tpu.ml.evaluation.MulticlassClassificationEvaluator",

@@ -238,3 +238,64 @@ def test_one_hot_encoder_nonfinite():
                          handleInvalid="keep").transform(df).collect()
     assert kept[0]["v"] == [0.0, 0.0, 0.0]  # NaN -> invalid category
     assert kept[1]["v"] == [1.0, 0.0, 0.0]
+
+
+def test_min_max_scaler(rng, tmp_path):
+    from sparkdl_tpu.ml import MinMaxScaler, MinMaxScalerModel
+
+    x = np.column_stack([rng.uniform(-5, 15, 30), np.full(30, 7.0)])
+    df = DataFrame.fromRows([{"v": x[i].tolist()} for i in range(30)],
+                            numPartitions=3)
+    model = MinMaxScaler(inputCol="v", outputCol="s").fit(df)
+    out = np.asarray([r["s"] for r in model.transform(df).collect()])
+    assert out[:, 0].min() == pytest.approx(0.0)
+    assert out[:, 0].max() == pytest.approx(1.0)
+    # constant dimension maps to the midpoint (Spark rule)
+    np.testing.assert_allclose(out[:, 1], 0.5)
+    # custom range + persistence
+    m2 = MinMaxScaler(inputCol="v", outputCol="s", min=-1.0,
+                      max=1.0).fit(df)
+    m2.save(str(tmp_path / "mm"))
+    from sparkdl_tpu.ml import load
+    out2 = np.asarray([r["s"] for r in
+                       load(str(tmp_path / "mm")).transform(df).collect()])
+    assert isinstance(load(str(tmp_path / "mm")), MinMaxScalerModel)
+    assert out2[:, 0].min() == pytest.approx(-1.0)
+    assert out2[:, 0].max() == pytest.approx(1.0)
+    with pytest.raises(ValueError, match="min"):
+        MinMaxScaler(inputCol="v", outputCol="s", min=2.0, max=1.0).fit(df)
+    # NaN/null elements would silently midpoint a dimension — fit raises
+    dirty = DataFrame.fromRows([{"v": [1.0, float("nan")]},
+                                {"v": [2.0, 3.0]}])
+    with pytest.raises(ValueError, match="impute"):
+        MinMaxScaler(inputCol="v", outputCol="s").fit(dirty)
+
+
+def test_imputer(tmp_path):
+    from sparkdl_tpu.ml import Imputer, ImputerModel, load
+
+    rows = [{"v": [1.0, 10.0]}, {"v": [3.0, None]}, {"v": None},
+            {"v": [5.0, 30.0]}]
+    df = DataFrame.fromRows(rows, numPartitions=2)
+    model = Imputer(inputCol="v", outputCol="f").fit(df)
+    # means over observed values: (1+3+5)/3 = 3, (10+30)/2 = 20
+    np.testing.assert_allclose(model.getSurrogates(), [3.0, 20.0])
+    out = [r["f"] for r in model.transform(df).collect()]
+    assert out[1] == [3.0, 20.0]   # NaN element filled
+    assert out[2] == [3.0, 20.0]   # null row filled
+    assert out[0] == [1.0, 10.0]   # observed values untouched
+    # Spark's percentile_approx(0.5) returns an ACTUAL element: the
+    # lower-middle for even counts — dim1 observed [10, 30] -> 10
+    med = Imputer(inputCol="v", outputCol="f", strategy="median").fit(df)
+    np.testing.assert_allclose(med.getSurrogates(), [3.0, 10.0])
+    # inf is a regular value, not missing (Spark): mean becomes inf
+    inf_df = DataFrame.fromRows([{"v": [1.0]}, {"v": [float("inf")]}])
+    inf_model = Imputer(inputCol="v", outputCol="f").fit(inf_df)
+    assert np.isinf(inf_model.getSurrogates()[0])
+    model.save(str(tmp_path / "imp"))
+    loaded = load(str(tmp_path / "imp"))
+    assert isinstance(loaded, ImputerModel)
+    np.testing.assert_allclose(loaded.getSurrogates(), [3.0, 20.0])
+    with pytest.raises(ValueError, match="NO observed"):
+        Imputer(inputCol="v", outputCol="f").fit(
+            DataFrame.fromRows([{"v": [None, 1.0]}]))
