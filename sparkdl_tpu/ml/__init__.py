@@ -21,12 +21,15 @@ from sparkdl_tpu.ml.classification import (
 )
 from sparkdl_tpu.ml.estimator import KerasImageFileEstimator, KerasImageFileModel
 from sparkdl_tpu.ml.feature import (
+    Binarizer,
     Imputer,
     ImputerModel,
     IndexToString,
     MinMaxScaler,
     MinMaxScalerModel,
+    Normalizer,
     OneHotEncoder,
+    SQLTransformer,
     StandardScaler,
     StandardScalerModel,
     StringIndexer,
@@ -73,8 +76,11 @@ __all__ = [
     "RegressionEvaluator",
     "TrainValidationSplit",
     "TrainValidationSplitModel",
+    "Binarizer",
     "Imputer",
     "ImputerModel",
+    "Normalizer",
+    "SQLTransformer",
     "IndexToString",
     "MinMaxScaler",
     "MinMaxScalerModel",

@@ -729,6 +729,128 @@ class ImputerModel(Model, _IndexerParams, ParamsOnlyPersistence):
                                   outputType=pa.list_(pa.float64()))
 
 
+class Normalizer(Transformer, _IndexerParams, ParamsOnlyPersistence):
+    """Scale each vector row to unit p-norm (Spark's Normalizer;
+    default p=2). Zero rows pass through unchanged (Spark behavior)."""
+
+    p = Param("Normalizer", "p", "norm order (p >= 1; default 2.0)",
+              typeConverter=TypeConverters.toFloat)
+
+    @keyword_only
+    def __init__(self, *, inputCol: Optional[str] = None,
+                 outputCol: Optional[str] = None, p: float = 2.0) -> None:
+        super().__init__()
+        self._setDefault(p=2.0)
+        self._set(**self._input_kwargs)
+
+    def _transform(self, dataset):
+        import numpy as np
+        import pyarrow as pa
+
+        p = self.getOrDefault(self.p)
+        if p < 1.0:
+            raise ValueError(f"p must be >= 1, got {p}")
+
+        def normalize(v):
+            if v is None:
+                return None
+            x = np.asarray(v, np.float64)
+            norm = float(np.linalg.norm(x, ord=p))
+            if norm == 0:  # exact-zero rows pass through (Spark)
+                return x.tolist()
+            # a NaN norm divides through — NaN elements propagate to the
+            # whole row like Spark, never a silently un-normalized row
+            return (x / norm).tolist()
+
+        return dataset.withColumn(self.getOutputCol(), normalize,
+                                  inputCols=[self.getInputCol()],
+                                  outputType=pa.list_(pa.float64()))
+
+
+class Binarizer(Transformer, _IndexerParams, ParamsOnlyPersistence):
+    """Threshold a numeric or vector column to 0/1 (Spark's Binarizer:
+    strictly greater than ``threshold`` → 1.0)."""
+
+    threshold = Param("Binarizer", "threshold",
+                      "values > threshold become 1.0 (default 0.0)",
+                      typeConverter=TypeConverters.toFloat)
+
+    @keyword_only
+    def __init__(self, *, inputCol: Optional[str] = None,
+                 outputCol: Optional[str] = None,
+                 threshold: float = 0.0) -> None:
+        super().__init__()
+        self._setDefault(threshold=0.0)
+        self._set(**self._input_kwargs)
+
+    def _transform(self, dataset):
+        import numpy as np
+        import pyarrow as pa
+
+        t = self.getOrDefault(self.threshold)
+
+        def binarize(v):
+            if v is None:
+                return None
+            if isinstance(v, (list, tuple)):
+                return (np.asarray(v, np.float64) > t) \
+                    .astype(np.float64).tolist()
+            return 1.0 if float(v) > t else 0.0
+
+        # Declare the output type from the INPUT column's declared type:
+        # leaving it to inference would type the lazy column pa.null(),
+        # which defeats downstream schema-driven logic (VectorAssembler's
+        # vector-column detection and its null-vector-cell guard).
+        in_type = dataset.schema.field(self.getInputCol()).type
+        if (pa.types.is_list(in_type) or pa.types.is_large_list(in_type)
+                or pa.types.is_fixed_size_list(in_type)):
+            out_type = pa.list_(pa.float64())
+        elif pa.types.is_null(in_type):
+            out_type = None  # unknown upstream type: defer to inference
+        else:
+            out_type = pa.float64()
+        return dataset.withColumn(self.getOutputCol(), binarize,
+                                  inputCols=[self.getInputCol()],
+                                  outputType=out_type)
+
+
+class SQLTransformer(Transformer, Params, ParamsOnlyPersistence):
+    """A SQL statement as a Pipeline stage (Spark's SQLTransformer):
+    ``statement`` runs against the input frame bound as ``__THIS__`` —
+    registered UDFs, WHERE filters, aliases and literals all work, so a
+    served model (``registerImageUDF``) composes into a Pipeline as one
+    stage: ``SQLTransformer(statement="SELECT my_udf(image) AS f, label
+    FROM __THIS__ WHERE label IS NOT NULL")``."""
+
+    statement = Param("SQLTransformer", "statement",
+                      "SQL with __THIS__ as the input table",
+                      typeConverter=TypeConverters.toString)
+
+    @keyword_only
+    def __init__(self, *, statement: Optional[str] = None) -> None:
+        super().__init__()
+        self._set(**self._input_kwargs)
+
+    def getStatement(self) -> str:
+        return self.getOrDefault(self.statement)
+
+    def _transform(self, dataset):
+        import uuid
+
+        from sparkdl_tpu.engine import dataframe as _df
+
+        statement = self.getStatement()
+        if "__THIS__" not in statement:
+            raise ValueError(
+                f"statement must reference __THIS__: {statement!r}")
+        view = f"sdl_sqlt_{uuid.uuid4().hex[:12]}"
+        _df._temp_views[view] = dataset
+        try:
+            return _df.sql(statement.replace("__THIS__", view))
+        finally:
+            _df._temp_views.pop(view, None)
+
+
 class IndexToString(Transformer, _IndexerParams, ParamsOnlyPersistence):
     """Inverse mapping: float index column → label string column."""
 

@@ -58,6 +58,9 @@ _LOADABLE = {
     "sparkdl_tpu.ml.feature.MinMaxScaler",
     "sparkdl_tpu.ml.feature.MinMaxScalerModel",
     "sparkdl_tpu.ml.feature.Imputer",
+    "sparkdl_tpu.ml.feature.Normalizer",
+    "sparkdl_tpu.ml.feature.Binarizer",
+    "sparkdl_tpu.ml.feature.SQLTransformer",
     "sparkdl_tpu.ml.feature.ImputerModel",
     "sparkdl_tpu.ml.regression.LinearRegression",
     "sparkdl_tpu.ml.regression.LinearRegressionModel",

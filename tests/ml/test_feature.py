@@ -299,3 +299,85 @@ def test_imputer(tmp_path):
     with pytest.raises(ValueError, match="NO observed"):
         Imputer(inputCol="v", outputCol="f").fit(
             DataFrame.fromRows([{"v": [None, 1.0]}]))
+
+
+def test_normalizer_and_binarizer(tmp_path):
+    from sparkdl_tpu.ml import Binarizer, Normalizer, load
+
+    df = DataFrame.fromRows([{"v": [3.0, 4.0]}, {"v": [0.0, 0.0]},
+                             {"v": None}])
+    out = [r["n"] for r in Normalizer(inputCol="v", outputCol="n")
+           .transform(df).collect()]
+    np.testing.assert_allclose(out[0], [0.6, 0.8])
+    assert out[1] == [0.0, 0.0]  # zero rows pass through
+    assert out[2] is None
+    l1 = Normalizer(inputCol="v", outputCol="n", p=1.0).transform(df) \
+        .collect()
+    np.testing.assert_allclose(l1[0]["n"], [3 / 7, 4 / 7])
+    with pytest.raises(ValueError, match="p must"):
+        Normalizer(inputCol="v", outputCol="n", p=0.5).transform(df) \
+            .collect()
+
+    sdf = DataFrame.fromRows([{"x": 0.4}, {"x": 0.6}, {"x": None}])
+    b = Binarizer(inputCol="x", outputCol="b", threshold=0.5)
+    assert [r["b"] for r in b.transform(sdf).collect()] == [0.0, 1.0, None]
+    vb = Binarizer(inputCol="v", outputCol="b", threshold=2.0)
+    assert vb.transform(df).collect()[0]["b"] == [1.0, 1.0]
+    b.save(str(tmp_path / "bin"))
+    assert load(str(tmp_path / "bin")).getOrDefault("threshold") == 0.5
+
+
+def test_sql_transformer_in_pipeline(rng):
+    """Spark's SQLTransformer: a SQL statement as a Pipeline stage over
+    __THIS__, composing a registered model UDF + WHERE filter with a
+    downstream learner."""
+    from sparkdl_tpu.core.model_function import ModelFunction, TensorSpec
+    from sparkdl_tpu.ml import SQLTransformer
+    from sparkdl_tpu.udf import registerTensorUDF
+
+    import jax.numpy as jnp
+
+    mf = ModelFunction(lambda v, x: x * v["s"], {"s": jnp.asarray(3.0)},
+                       TensorSpec((None, 2), "float32"), name="triple")
+    registerTensorUDF("triple_udf", mf, batchSize=4)
+    x = rng.normal(size=(10, 2)).astype(np.float32)
+    df = DataFrame.fromRows(
+        [{"vec": x[i].tolist(), "keep": i % 2} for i in range(10)],
+        numPartitions=2)
+    stage = SQLTransformer(
+        statement="SELECT triple_udf(vec) AS out, keep FROM __THIS__ "
+                  "WHERE keep = 1")
+    out = stage.transform(df).collect()
+    assert len(out) == 5 and all(r["keep"] == 1 for r in out)
+    np.testing.assert_allclose(out[0]["out"], x[1] * 3.0, rtol=1e-6)
+    # inside a Pipeline
+    pipe = Pipeline(stages=[stage])
+    assert len(pipe.fit(df).transform(df).collect()) == 5
+    with pytest.raises(ValueError, match="__THIS__"):
+        SQLTransformer(statement="SELECT 1 FROM x").transform(df)
+    # the scratch view is cleaned up
+    from sparkdl_tpu.engine import dataframe as _df
+    assert not [v for v in _df._temp_views if v.startswith("sdl_sqlt_")]
+
+
+def test_normalizer_nan_propagates_and_binarizer_typed():
+    from sparkdl_tpu.ml import Binarizer, Normalizer, VectorAssembler
+
+    import pyarrow as pa
+
+    nan_df = DataFrame.fromRows([{"v": [float("nan"), 3.0]}])
+    out = Normalizer(inputCol="v", outputCol="n").transform(nan_df) \
+        .collect()
+    assert all(np.isnan(out[0]["n"]))  # NaN propagates (Spark), no
+    # silently un-normalized row
+
+    # Binarizer declares a typed output, so VectorAssembler's
+    # vector-column guard fires on null cells downstream
+    df = DataFrame.fromRows([{"v": [3.0, 4.0]}, {"v": None}])
+    binarized = Binarizer(inputCol="v", outputCol="b",
+                          threshold=2.0).transform(df)
+    assert pa.types.is_list(binarized.schema.field("b").type)
+    va = VectorAssembler(inputCols=["b"], outputCol="f",
+                         handleInvalid="keep")
+    with pytest.raises(Exception, match="vector column"):
+        va.transform(binarized).collect()
