@@ -11,11 +11,16 @@ from sparkdl_tpu.core.mesh import (
 )
 from sparkdl_tpu.core.model_function import ModelFunction, InputModel, TensorSpec
 from sparkdl_tpu.core import batching
+from sparkdl_tpu.core import resilience
+from sparkdl_tpu.core.resilience import (
+    Deadline, Fault, FaultInjector, RetryPolicy, classify,
+)
 
 __all__ = [
     "DATA_AXIS", "MODEL_AXIS", "CONTEXT_AXIS", "EXPERT_AXIS",
     "MeshConfig", "make_mesh", "data_parallel_mesh", "batch_sharding",
     "replicated", "shard_batch",
     "ModelFunction", "InputModel", "TensorSpec",
-    "batching",
+    "batching", "resilience",
+    "Deadline", "Fault", "FaultInjector", "RetryPolicy", "classify",
 ]

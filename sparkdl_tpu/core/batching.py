@@ -10,9 +10,14 @@ shapes".)
 
 from __future__ import annotations
 
-from typing import Callable, Iterator, Optional, Tuple
+import logging
+from typing import Callable, Iterator, List, Optional, Tuple
 
 import numpy as np
+
+from sparkdl_tpu.core import resilience
+
+logger = logging.getLogger(__name__)
 
 # Transfer economics of the staging path (r3, measured with true barriers —
 # scalar fetched through a jitted reduction; block_until_ready is NOT a
@@ -98,8 +103,63 @@ def iter_batches_tree(tree, batch_size: int, multiple: int = 1):
         yield treedef.unflatten(chunk_leaves), n_valid
 
 
+def _valid_rows(chunk, n_valid: int):
+    """Strip pad rows: the original (unpadded) rows of a padded chunk."""
+    import jax
+
+    return jax.tree_util.tree_map(lambda leaf: leaf[:n_valid], chunk)
+
+
+def _dispatch_chunk(fn: Callable, chunk, n_valid: int,
+                    multiple: int, policy: resilience.RetryPolicy
+                    ) -> List[Tuple[object, int]]:
+    """Dispatch one padded chunk with classified retry + OOM re-chunking.
+
+    Returns ``[(device_out, n_valid), ...]`` in row order — one pair
+    normally, several when an OOM forced the chunk to re-run as smaller
+    sub-chunks. Semantics per failure kind (core.resilience):
+
+    - FATAL: propagate immediately; retrying a shape/dtype error replays it.
+    - RETRYABLE: bounded backoff retry via ``policy.execute`` (same chunk,
+      same shape — one compiled program).
+    - OOM: halve the bucket, re-chunk THIS chunk's valid rows, recurse —
+      the padded rows are zeros, so dropping them and re-padding at the
+      smaller bucket computes the same per-row values (outputs stay
+      bit-identical and order-preserving). An OOM at the minimal bucket
+      (≤ the mesh data-axis multiple) propagates to apply_batch's
+      whole-call fallback.
+    """
+    import jax
+
+    rows = jax.tree_util.tree_leaves(chunk)[0].shape[0]
+
+    def attempt():
+        resilience.inject("device_oom", rows=rows, valid=n_valid)
+        resilience.inject("transfer_stall", rows=rows)
+        return [(fn(chunk), n_valid)]  # dispatched async; no block here
+
+    try:
+        return policy.execute(attempt, what=f"chunk dispatch (bucket {rows})")
+    except Exception as e:  # noqa: BLE001 - classified below
+        if resilience.classify(e) != resilience.OOM:
+            raise
+        half = rows // 2
+        if half < max(1, multiple):
+            raise
+        logger.warning(
+            "device OOM at bucket %d (%s); re-chunking %d valid "
+            "row(s) at bucket %d", rows, e, n_valid, half)
+        out: List[Tuple[object, int]] = []
+        for sub, sub_valid in iter_batches_tree(
+                _valid_rows(chunk, n_valid), half, multiple):
+            out.extend(_dispatch_chunk(fn, sub, sub_valid,
+                                       multiple, policy))
+        return out
+
+
 def run_batched(fn: Callable, tree, batch_size: int,
-                multiple: int = 1):
+                multiple: int = 1,
+                retry_policy: Optional[resilience.RetryPolicy] = None):
     """Apply a fixed-batch device fn over all rows, concatenating outputs.
 
     ``tree``: one array or a pytree of dim-0-aligned arrays (multi-input
@@ -111,14 +171,22 @@ def run_batched(fn: Callable, tree, batch_size: int,
     are concatenated ON DEVICE so the host pays ONE device→host fetch per
     leaf per call instead of one ~100 ms round-trip per bucket.
     ``multiple``: bucket-size divisibility constraint (mesh data axis).
+
+    Per-chunk failures are classified (core.resilience): transient errors
+    retry with backoff, device OOM re-chunks at a halved bucket (results
+    stay bit-identical and order-preserving), fatal errors propagate.
+    ``retry_policy=None`` uses ``resilience.DEFAULT_INFERENCE_POLICY``.
     """
     import jax
 
+    policy = (retry_policy if retry_policy is not None
+              else resilience.DEFAULT_INFERENCE_POLICY)
     outs = []
     valids = []
     for chunk, n_valid in iter_batches_tree(tree, batch_size, multiple):
-        outs.append(fn(chunk))  # dispatched async; do not block here
-        valids.append(n_valid)
+        for out, v in _dispatch_chunk(fn, chunk, n_valid, multiple, policy):
+            outs.append(out)
+            valids.append(v)
     if not outs:
         # Preserve the output *element* shape for empty inputs: run one
         # dummy padded batch through shape inference only.

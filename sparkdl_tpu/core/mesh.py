@@ -14,6 +14,7 @@ Axis names are fixed framework-wide so PartitionSpec rules compose:
 
 from __future__ import annotations
 
+import logging
 import math
 from dataclasses import dataclass, field
 from typing import Dict, Optional, Sequence, Tuple
@@ -21,6 +22,8 @@ from typing import Dict, Optional, Sequence, Tuple
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+logger = logging.getLogger(__name__)
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
@@ -94,6 +97,19 @@ def host_local_mesh(mesh: Optional[Mesh]) -> Optional[Mesh]:
     local = set(jax.local_devices())
     if all(d in local for d in mesh.devices.flat):
         return mesh
+    nontrivial = {axis: mesh.shape[axis]
+                  for axis in (MODEL_AXIS, CONTEXT_AXIS, EXPERT_AXIS)
+                  if mesh.shape.get(axis, 1) > 1}
+    if nontrivial:
+        # Silently discarding a model/context/expert axis would surface
+        # much later as an inexplicable per-host OOM (params that were
+        # sharded across hosts suddenly replicated); make the loss
+        # diagnosable at the substitution site (ADVICE r5).
+        logger.warning(
+            "host_local_mesh: replacing a multi-host mesh with per-host "
+            "data parallelism discards its non-trivial %s axes — "
+            "parameter/sequence sharding is lost and per-host memory use "
+            "will grow accordingly", nontrivial)
     return data_parallel_mesh(jax.local_devices())
 
 

@@ -387,7 +387,7 @@ class ModelFunction:
         return fn
 
     def apply_batch(self, array, batch_size: int = 64,
-                    mesh=None) -> np.ndarray:
+                    mesh=None, retry_policy=None) -> np.ndarray:
         """Run over N rows with fixed-shape padded chunks; returns numpy.
 
         ``array``: one ndarray, or — for multi-input models whose
@@ -396,7 +396,16 @@ class ModelFunction:
         analog); outputs mirror the model's structure. uint8 input stages
         as uint8 (the jitted program casts on device — quarter the
         transfer bytes); anything else is cast host-side to the spec dtype.
+
+        Runtime failures are classified per chunk (core.resilience):
+        transient errors retry with backoff; a device OOM re-chunks at a
+        halved bucket, preserving row order and values; fatal errors
+        propagate untouched. OOMs that only surface at the deferred
+        device→host fetch (async dispatch) re-run the whole call at a
+        halved ``batch_size`` — inputs are host-resident, so the re-run is
+        idempotent.
         """
+        from sparkdl_tpu.core import resilience
 
         def stage_cast(arr, spec):
             arr = np.asarray(arr)
@@ -416,7 +425,22 @@ class ModelFunction:
             from sparkdl_tpu.core.mesh import data_axis_size, pad_to_multiple
             multiple = data_axis_size(mesh)
             batch_size = pad_to_multiple(batch_size, multiple)
-        return batching.run_batched(fn, array, batch_size, multiple=multiple)
+        while True:
+            try:
+                return batching.run_batched(fn, array, batch_size,
+                                            multiple=multiple,
+                                            retry_policy=retry_policy)
+            except Exception as e:  # noqa: BLE001 - classified below
+                half = batch_size // 2
+                if (resilience.classify(e) != resilience.OOM
+                        or half < max(1, multiple)):
+                    raise
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "%s: device OOM at batch_size %d (%s); re-running at %d",
+                    self.name, batch_size, e, half)
+                batch_size = half
 
     def __call__(self, x) -> jax.Array:
         return self.apply_fn(self.variables, x)

@@ -299,14 +299,26 @@ def eval_bool(node, env: Dict[str, Any]) -> Optional[bool]:
         inner = eval_bool(node.node, env)
         return None if inner is None else not inner
     if isinstance(node, BoolOp):
-        vals = [eval_bool(p, env) for p in node.parts]
+        # Genuinely short-circuit: stop at the first deciding operand
+        # (False for AND, True for OR) without evaluating the rest — SQL
+        # UNKNOWN (None) cannot flip a decided AND/OR, so skipping the
+        # remaining operands is semantics-preserving and a per-row win.
+        saw_unknown = False
         if node.kind == "and":
-            if any(v is False for v in vals):
-                return False
-            return None if any(v is None for v in vals) else True
-        if any(v is True for v in vals):
-            return True
-        return None if any(v is None for v in vals) else False
+            for p in node.parts:
+                v = eval_bool(p, env)
+                if v is False:
+                    return False
+                if v is None:
+                    saw_unknown = True
+            return None if saw_unknown else True
+        for p in node.parts:
+            v = eval_bool(p, env)
+            if v is True:
+                return True
+            if v is None:
+                saw_unknown = True
+        return None if saw_unknown else False
     if isinstance(node, Compare):
         left = _eval_value(node.left, env)
         right = _eval_value(node.right, env)

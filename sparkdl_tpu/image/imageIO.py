@@ -17,12 +17,17 @@ works.
 
 from __future__ import annotations
 
+import logging
 import os
 from collections import namedtuple
 from typing import Callable, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
 import pyarrow as pa
+
+from sparkdl_tpu.core import resilience
+
+logger = logging.getLogger(__name__)
 
 # ---------------------------------------------------------------------------
 # Schema: field-for-field the Spark ImageSchema struct the reference used.
@@ -130,21 +135,65 @@ def imageStructsToBatchArray(structs: Sequence[dict],
     keeps NHWC rank when ``target_size`` is known (empty partitions flow
     through filter/dropna and must not change rank downstream).
     """
-    arrays = []
-    for s in structs:
-        arr = imageStructToArray(s)
-        if target_size is not None and arr.shape[:2] != tuple(target_size):
-            arr = resizeImageArray(arr, target_size)
-        arrays.append(arr if dtype is None else np.asarray(arr, dtype=dtype))
+    batch, _kept, _dropped = _stage_structs(structs, target_size, dtype,
+                                            channels, tolerant=False)
+    return batch
+
+
+def imageStructsToBatchArrayTolerant(
+        structs: Sequence[dict],
+        target_size: Optional[Tuple[int, int]] = None,
+        dtype: Optional[str] = "float32",
+        channels: int = 3
+) -> Tuple[np.ndarray, List[int], int]:
+    """Like :func:`imageStructsToBatchArray`, but malformed rows degrade.
+
+    Rows whose struct cannot be decoded (bad mode code, data bytes that
+    don't match the declared shape, injected ``decode_error`` faults)
+    are DROPPED instead of aborting the whole partition — Spark's
+    corrupt-image convention (the reference read such rows as null
+    structs). Returns ``(batch, kept_indices, n_dropped)`` where
+    ``kept_indices`` indexes ``structs`` for the rows present in
+    ``batch`` (order-preserving).
+    """
+    return _stage_structs(structs, target_size, dtype, channels,
+                          tolerant=True)
+
+
+def _stage_structs(structs, target_size, dtype, channels, tolerant: bool
+                   ) -> Tuple[np.ndarray, List[int], int]:
+    """Shared staging core: one implementation so the strict and tolerant
+    paths can never drift apart in resize/dtype/empty-shape semantics."""
+    arrays: List[np.ndarray] = []
+    kept: List[int] = []
+    dropped = 0
+    for i, s in enumerate(structs):
+        try:
+            if tolerant and resilience.should_fire("decode_error"):
+                raise ValueError("injected decode_error")
+            arr = imageStructToArray(s)
+            if (target_size is not None
+                    and arr.shape[:2] != tuple(target_size)):
+                arr = resizeImageArray(arr, target_size)
+            arrays.append(arr if dtype is None
+                          else np.asarray(arr, dtype=dtype))
+            kept.append(i)
+        except Exception as e:  # noqa: BLE001 - per-row degradation
+            if not tolerant:
+                raise
+            dropped += 1
+            logger.debug("dropping undecodable image row %d: %s", i, e)
     if arrays:
         if dtype is None and len({a.dtype for a in arrays}) > 1:
             arrays = [np.asarray(a, dtype="float32") for a in arrays]
-        return np.stack(arrays)
+        return np.stack(arrays), kept, dropped
     empty_dtype = dtype or "uint8"
     if target_size is not None:
-        return np.zeros((0, target_size[0], target_size[1], channels),
-                        dtype=empty_dtype)
-    return np.zeros((0,), dtype=empty_dtype)
+        empty = np.zeros((0, target_size[0], target_size[1], channels),
+                         dtype=empty_dtype)
+    else:
+        empty = np.zeros((0,), dtype=empty_dtype)
+    return empty, kept, dropped
 
 
 def arrowImageBatch(col) -> Optional[Tuple[np.ndarray, np.ndarray]]:
@@ -240,8 +289,13 @@ def decodeImageBytes(data: bytes, target_size=None,
 
     if channels is not None:
         if target_size is not None:
+            # decode_error injection happens inside the batch decoder —
+            # checking here too would consume two fault occurrences per
+            # decode and mistarget occurrence-indexed Faults
             return decodeImageBytesBatch([data], target_size,
                                          channels=channels)[0]
+        if resilience.should_fire("decode_error"):
+            return None
         # no target size: native decode (fast path, GIL released)
         # preserves channels; coerce after
         if native_loader.available():
@@ -249,6 +303,8 @@ def decodeImageBytes(data: bytes, target_size=None,
             if arr is not None:
                 return forceChannels(arr, channels)
         return _pil_decode_channels(data, None, channels)
+    if resilience.should_fire("decode_error"):
+        return None
     if native_loader.available():
         arr = native_loader.decode(data, target_size=target_size)
         if arr is not None:
@@ -292,7 +348,8 @@ def decodeImageBytesBatch(blobs: Sequence[Optional[bytes]],
     from sparkdl_tpu.native import loader as native_loader
 
     out: List[Optional[np.ndarray]] = [None] * len(blobs)
-    valid = [i for i, b in enumerate(blobs) if b]
+    valid = [i for i, b in enumerate(blobs)
+             if b and not resilience.should_fire("decode_error")]
     if not valid:
         return out
     res = native_loader.decode_batch_status(

@@ -215,8 +215,10 @@ class BinaryClassificationEvaluator(Evaluator, HasLabelCol,
     Curve semantics (documented contract, asserted by hand-computed
     tests): points are taken at every distinct score threshold with ties
     grouped; areaUnderROC is the trapezoid integral of TPR over FPR from
-    (0,0); areaUnderPR prepends Spark's (recall=0, precision=1.0) anchor
-    and integrates precision over recall by trapezoid.
+    (0,0); areaUnderPR prepends Spark's (recall=0, firstPrecision)
+    anchor — the first curve point's precision, matching
+    ``BinaryClassificationMetrics`` — and integrates precision over
+    recall by trapezoid.
 
     Unlike the multiclass/regression evaluators (streaming sufficient
     statistics), exact AUC needs the full score vector for the global
@@ -303,7 +305,13 @@ class BinaryClassificationEvaluator(Evaluator, HasLabelCol,
             fpr = np.r_[0.0, fp / neg]
             return float(_trapezoid(tpr, fpr))
         recall = np.r_[0.0, tp / pos]
-        precision = np.r_[1.0, tp / (tp + fp)]
+        # Spark parity (ADVICE r5): the PR curve is anchored at
+        # (recall=0, precision=first point's precision) — Spark's
+        # BinaryClassificationMetrics prepends (0.0, firstPrecision), NOT
+        # an optimistic (0, 1.0), which would inflate AUPR whenever the
+        # top-scoring threshold group contains a negative.
+        prec_curve = tp / (tp + fp)
+        precision = np.r_[prec_curve[0], prec_curve]
         return float(_trapezoid(precision, recall))
 
 

@@ -17,10 +17,13 @@ compiles once per batch size.
 
 from __future__ import annotations
 
+import logging
 from typing import Optional, Tuple
 
 import numpy as np
 import pyarrow as pa
+
+logger = logging.getLogger(__name__)
 
 from sparkdl_tpu.core import profiling
 from sparkdl_tpu.engine.dataframe import fixed_size_list_array
@@ -145,17 +148,28 @@ class TPUImageTransformer(Transformer, HasInputCol, HasOutputCol,
                 return _images_with_nulls(out, valid, batch.num_rows, origins)
 
             structs = col.to_pylist()
-            valid = [i for i, s in enumerate(structs) if s is not None]
+            present = [i for i, s in enumerate(structs) if s is not None]
+            # dtype=None: uint8 images stage as uint8 (4x fewer DMA bytes);
+            # the jitted program casts to the spec dtype on device.
+            # Tolerant staging: malformed structs (corrupt bytes, bad mode
+            # codes, injected decode_error faults) degrade to null output
+            # cells instead of aborting the partition (Spark's
+            # corrupt-image convention); the drop count is surfaced below.
+            with profiling.annotate("sparkdl.host_stage"):
+                stacked, kept, dropped = \
+                    imageIO.imageStructsToBatchArrayTolerant(
+                        [structs[i] for i in present],
+                        target_size=target_size, dtype=None)
+            if dropped:
+                logger.warning(
+                    "TPUImageTransformer: dropped %d undecodable image "
+                    "row(s) of %d in partition (%r) — emitting null cells",
+                    dropped, len(present), input_col)
+            valid = [present[j] for j in kept]
             if not valid:
                 out_type = (pa.list_(pa.float32()) if mode == "vector"
                             else imageIO.imageSchema)
                 return pa.array([None] * batch.num_rows, type=out_type)
-            # dtype=None: uint8 images stage as uint8 (4x fewer DMA bytes);
-            # the jitted program casts to the spec dtype on device.
-            with profiling.annotate("sparkdl.host_stage"):
-                stacked = imageIO.imageStructsToBatchArray(
-                    [structs[i] for i in valid], target_size=target_size,
-                    dtype=None)
             with profiling.annotate("sparkdl.device_apply"):
                 out = run.apply_batch(stacked, batch_size=batch_size,
                                       mesh=mesh)

@@ -17,11 +17,14 @@ no network access is assumed anywhere.
 from __future__ import annotations
 
 import json
+import logging
 import os
 from typing import Optional
 
 import numpy as np
 import pyarrow as pa
+
+logger = logging.getLogger(__name__)
 
 from sparkdl_tpu.ml.base import Transformer
 from sparkdl_tpu.ml.image_transformer import TPUImageTransformer
@@ -280,12 +283,21 @@ class DeepImagePredictor(_NamedImageTransformer):
             pa.field("probability", pa.float32())]))
 
         def decode_row(probs):
+            # Degrade per row, never abort the partition: a null input
+            # cell (undecodable image upstream) or a malformed probability
+            # vector becomes a null decoded cell (docs/RESILIENCE.md).
             if probs is None:
                 return None
-            p = np.asarray(probs, dtype=np.float32)
-            top = np.argsort(-p)[:k]
-            return [{"class": labels[i][0], "description": labels[i][1],
-                     "probability": float(p[i])} for i in top]
+            try:
+                p = np.asarray(probs, dtype=np.float32)
+                top = np.argsort(-p)[:k]
+                return [{"class": labels[i][0], "description": labels[i][1],
+                         "probability": float(p[i])} for i in top]
+            except (ValueError, TypeError, IndexError) as e:
+                logger.warning(
+                    "DeepImagePredictor: undecodable probability row "
+                    "(%s: %s) — emitting null", type(e).__name__, e)
+                return None
 
         frame = frame.withColumn(out_col, decode_row, inputCols=[raw_col],
                                  outputType=decoded_type)

@@ -183,6 +183,20 @@ class _ValidatorParams(Params):
                         f"{param.name!r}: the estimator "
                         f"({type(self.estimator).__name__}) does not own "
                         "it (nested-stage param maps do not round-trip)")
+                # Name alone is not identity (ADVICE r5): a foreign param
+                # whose name collides with one of the estimator's would
+                # serialize fine and silently REBIND to the estimator's
+                # param on load — the grid would tune a different knob
+                # than the one the user built. Require the map's param to
+                # BE the estimator's param (Param equality is (parent uid,
+                # name), i.e. instance identity for bound params).
+                if param not in self.estimator.params:
+                    raise ValueError(
+                        f"Cannot persist a param map addressing "
+                        f"{param!r}: its name collides with "
+                        f"{self.estimator.getParam(param.name)!r} but it "
+                        f"belongs to a different component — resolving by "
+                        "name on load would silently rebind it")
                 try:
                     json.dumps(value)
                 except TypeError:

@@ -6,14 +6,28 @@ resume**; gang failure meant restarting the job. Here every training
 state component ``{params, opt_state, model_state, rng, step}`` is saved
 (optionally async) and restored exactly, which is what makes TPURunner's
 restart-from-checkpoint gang semantics work (§5.3).
+
+Resilience (docs/RESILIENCE.md): a synchronous ``save`` is atomic — Orbax
+commits a step by writing to a temporary directory and renaming, and
+``save(synchronous=True)`` verifies the step actually landed before
+returning, so a crash mid-write can never leave a half-step that
+``latest_step()`` would report. ``restore`` with no explicit step walks
+retained steps newest-first and falls back past corrupt/partial ones
+(bit rot, torn disks, the injected ``checkpoint_truncate`` fault) with a
+warning naming each skipped step.
 """
 
 from __future__ import annotations
 
+import logging
 import os
-from typing import Any, Optional
+from typing import Any, List, Optional
 
 import jax
+
+from sparkdl_tpu.core import resilience
+
+logger = logging.getLogger(__name__)
 
 
 class CheckpointManager:
@@ -32,25 +46,133 @@ class CheckpointManager:
             options=ocp.CheckpointManagerOptions(max_to_keep=keep,
                                                  create=True),
         )
+        # Steps THIS manager wrote in-session: re-saving one (e.g. fit's
+        # final synchronous save right after the per-step save of the same
+        # step) is a no-op, not an overwrite.
+        self._saved_steps: set = set()
 
     def save(self, step: int, state: Any, synchronous: bool = False) -> None:
         import orbax.checkpoint as ocp
 
-        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        if step in self._saved_steps:
+            pass  # already written by this manager; nothing new to persist
+        elif step in self._mgr.all_steps():
+            # Committed by a PREVIOUS gang attempt: the restarted run
+            # recomputed this step (bit-identical replay) — or restore
+            # fell back past a CORRUPT copy of it and the replay
+            # reproduced it. Orbax refuses to re-save an existing step
+            # (should_save() false → silent skip, or
+            # StepAlreadyExistsError under force), which would drop the
+            # recomputed step on the floor; delete-then-save instead.
+            logger.warning(
+                "checkpoint step %d already exists under %s (gang restart "
+                "recomputed it); overwriting", step, self.directory)
+            self._overwrite(step, state)
+            self._saved_steps.add(step)
+        else:
+            try:
+                self._mgr.save(step, args=ocp.args.StandardSave(state))
+            except Exception as e:  # StepAlreadyExistsError is not a
+                # ValueError in every orbax version; match the message
+                if "already exists" not in str(e):
+                    raise
+                # Race backstop: an abandoned async writer from a dead
+                # attempt committed this step between our check and now.
+                logger.warning(
+                    "checkpoint step %d landed concurrently under %s; "
+                    "overwriting", step, self.directory)
+                self._overwrite(step, state)
+            self._saved_steps.add(step)
         if synchronous:
             self._mgr.wait_until_finished()
+            # Atomicity check: Orbax finalizes a step by renaming its tmp
+            # dir; a step missing from all_steps() after the barrier means
+            # the commit never happened — fail HERE, not at some future
+            # restore of a checkpoint that silently doesn't exist.
+            if step not in self._mgr.all_steps():
+                raise IOError(
+                    f"checkpoint step {step} under {self.directory} was not "
+                    "committed (crash/IO failure mid-write?)")
+        if resilience.should_fire("checkpoint_truncate", step=step):
+            # Fault injection: corrupt the just-written step in place
+            # (truncate every file to half) to model bit rot / torn writes
+            # on a COMMITTED checkpoint — exercises restore's fallback.
+            self._mgr.wait_until_finished()
+            self._truncate_step(step)
+
+    def _overwrite(self, step: int, state: Any) -> None:
+        """Replace an existing step: orbax has no in-place overwrite, so
+        delete the committed copy and re-save (the new write is itself
+        atomic via the tmp-dir + rename commit)."""
+        import orbax.checkpoint as ocp
+
+        self._mgr.wait_until_finished()
+        try:
+            self._mgr.delete(step)
+        except Exception as e:  # noqa: BLE001 - a corrupt step may fail
+            # structured deletion; fall back to removing the directory
+            logger.warning("orbax delete of step %d failed (%s); removing "
+                           "its directory", step, e)
+            import shutil
+
+            shutil.rmtree(os.path.join(self.directory, str(step)),
+                          ignore_errors=True)
+            self._mgr.reload()
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+
+    def _truncate_step(self, step: int) -> None:
+        step_dir = os.path.join(self.directory, str(step))
+        for root, _dirs, files in os.walk(step_dir):
+            for name in files:
+                path = os.path.join(root, name)
+                size = os.path.getsize(path)
+                with open(path, "r+b") as f:
+                    f.truncate(size // 2)
+        logger.warning("FaultInjector: truncated checkpoint step %d files "
+                       "under %s", step, step_dir)
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
-    def restore(self, state_template: Any, step: Optional[int] = None) -> Any:
-        """Restore into the abstract/concrete template's pytree structure."""
-        import orbax.checkpoint as ocp
+    def all_steps(self) -> List[int]:
+        return sorted(self._mgr.all_steps())
 
-        step = step if step is not None else self._mgr.latest_step()
-        if step is None:
+    def restore(self, state_template: Any, step: Optional[int] = None) -> Any:
+        """Restore into the template's pytree structure.
+
+        With an explicit ``step``, exactly that step is restored (a
+        failure raises). With ``step=None``, retained steps are tried
+        newest-first: a corrupt/partial step logs a warning naming it and
+        falls back to the previous retained step; only when every
+        retained step fails does the last error propagate.
+        """
+        if step is not None:
+            return self._restore_step(step, state_template)
+        steps = sorted(self._mgr.all_steps(), reverse=True)
+        if not steps:
             raise FileNotFoundError(
                 f"No checkpoint found under {self.directory}")
+        first_err: Optional[BaseException] = None
+        for i, candidate in enumerate(steps):
+            try:
+                return self._restore_step(candidate, state_template)
+            except Exception as e:  # noqa: BLE001 - corrupt data raises
+                # anything (JSONDecodeError, OSError, Orbax internals)
+                first_err = first_err or e
+                if i + 1 >= len(steps):
+                    # Every retained step failed — a systemic problem
+                    # (e.g. a train-state format change hits ALL steps
+                    # equally), so report the NEWEST step's error, not
+                    # whichever happened to be oldest.
+                    raise first_err
+                logger.warning(
+                    "checkpoint step %d under %s failed to restore "
+                    "(%s: %s); falling back to step %d", candidate,
+                    self.directory, type(e).__name__, e, steps[i + 1])
+
+    def _restore_step(self, step: int, state_template: Any) -> Any:
+        import orbax.checkpoint as ocp
+
         template = jax.tree.map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
             if hasattr(x, "shape") and hasattr(x, "dtype") else x,
@@ -59,6 +181,13 @@ class CheckpointManager:
             return self._mgr.restore(step,
                                      args=ocp.args.StandardRestore(template))
         except (ValueError, KeyError) as e:
+            import json
+
+            if isinstance(e, json.JSONDecodeError):
+                # Truncated/corrupt metadata, not a structure mismatch —
+                # let restore()'s newest-first fallback handle it under
+                # its own (accurate) warning.
+                raise
             # Most common cause: the checkpoint predates a change in the
             # train-state pytree — e.g. named optimizers now wrap in
             # optax.inject_hyperparams (r4), which changed the opt_state

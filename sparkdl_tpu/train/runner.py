@@ -25,6 +25,7 @@ from typing import Any, Callable, Optional
 
 import jax
 
+from sparkdl_tpu.core import resilience
 from sparkdl_tpu.core.mesh import MeshConfig, make_mesh
 
 logger = logging.getLogger(__name__)
@@ -48,15 +49,34 @@ def maybe_initialize_distributed() -> bool:
 
 
 class TPURunner:
-    """Run a training function over an ``np``-device data-parallel mesh."""
+    """Run a training function over an ``np``-device data-parallel mesh.
+
+    Restart semantics (core.resilience): a failed ``main`` is classified —
+    FATAL errors (shape/dtype/``ValueError``: deterministic, a restart
+    replays them) raise immediately with zero restart attempts; everything
+    else (preemption, transient runtime errors — the gang-failure class)
+    restarts up to ``max_restarts`` times with exponential backoff and
+    deterministic jitter instead of a fixed delay. Train fns that
+    checkpoint via ``Trainer.fit(checkpoint=...)`` resume from
+    ``CheckpointManager.latest_step()``, not step 0.
+
+    ``retry_policy`` overrides the backoff schedule; when omitted, one is
+    built from ``restart_delay_s`` (kept as the base delay for
+    compatibility with the original fixed-delay API).
+    """
 
     def __init__(self, np: int = -1, max_restarts: int = 0,
                  restart_delay_s: float = 0.0,
-                 mesh_config: Optional[MeshConfig] = None) -> None:
+                 mesh_config: Optional[MeshConfig] = None,
+                 retry_policy: Optional[resilience.RetryPolicy] = None
+                 ) -> None:
         self.np = np
         self.max_restarts = max_restarts
         self.restart_delay_s = restart_delay_s
         self.mesh_config = mesh_config
+        self.retry_policy = retry_policy or resilience.RetryPolicy(
+            max_retries=max_restarts, base_delay_s=restart_delay_s,
+            max_delay_s=max(restart_delay_s * 8, 60.0))
 
     def _build_mesh(self):
         maybe_initialize_distributed()
@@ -90,13 +110,23 @@ class TPURunner:
             try:
                 return main(**call_kwargs)
             except Exception as e:  # noqa: BLE001 - gang boundary
+                if resilience.classify(e) == resilience.FATAL:
+                    # Deterministic failure: a restart replays it from the
+                    # checkpoint and fails again — surface it unretried.
+                    logger.error(
+                        "TPURunner: attempt %d failed with a fatal error "
+                        "(%s: %s); not restarting", attempt + 1,
+                        type(e).__name__, e)
+                    raise
                 last_err = e
                 if attempt + 1 < attempts:
+                    delay = self.retry_policy.delay(attempt + 1)
                     logger.warning(
-                        "TPURunner: attempt %d/%d failed (%s); restarting",
-                        attempt + 1, attempts, e)
-                    if self.restart_delay_s:
-                        time.sleep(self.restart_delay_s)
+                        "TPURunner: attempt %d/%d failed (%s: %s); "
+                        "restarting in %.2fs", attempt + 1, attempts,
+                        type(e).__name__, e, delay)
+                    if delay > 0:
+                        time.sleep(delay)
         raise RuntimeError(
             f"TPURunner: train fn failed after {attempts} attempts"
         ) from last_err

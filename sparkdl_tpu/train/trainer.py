@@ -25,7 +25,7 @@ import numpy as np
 import optax
 from flax import struct
 
-from sparkdl_tpu.core import profiling
+from sparkdl_tpu.core import profiling, resilience
 from sparkdl_tpu.core.mesh import batch_sharding, replicated
 from sparkdl_tpu.train.checkpoint import CheckpointManager
 from sparkdl_tpu.train.metrics import MetricsLogger
@@ -435,28 +435,48 @@ class Trainer:
         # the precise next batch.
         done = int(state.step)
         global_idx = 0
-        for _epoch in range(epochs):
-            for x, y in batches:
-                if global_idx < done:
+        try:
+            for _epoch in range(epochs):
+                for x, y in batches:
+                    if global_idx < done:
+                        global_idx += 1
+                        continue
+                    # int(state.step) inside the span: it is the per-step
+                    # sync point, so the timer records real step time, not
+                    # just the async dispatch.
+                    with profiling.annotate("sparkdl.train_step"):
+                        state, metrics = train_step(state, stage_batch(x),
+                                                    stage_batch(y))
+                        step = int(state.step)
                     global_idx += 1
-                    continue
-                # int(state.step) inside the span: it is the per-step sync
-                # point, so the timer records real step time, not just the
-                # async dispatch.
-                with profiling.annotate("sparkdl.train_step"):
-                    state, metrics = train_step(state, stage_batch(x),
-                                                stage_batch(y))
-                    step = int(state.step)
-                global_idx += 1
-                if metrics_logger is not None:
-                    metrics_logger.log_step(step, metrics, examples=len(x))
-                if (checkpoint is not None and checkpoint_every
-                        and step % checkpoint_every == 0):
-                    checkpoint.save(step, jax.device_get(state))
-                if on_step is not None:
-                    on_step(step)
-            if on_epoch is not None:
-                on_epoch(_epoch, state)
+                    if metrics_logger is not None:
+                        metrics_logger.log_step(step, metrics,
+                                                examples=len(x))
+                    if (checkpoint is not None and checkpoint_every
+                            and step % checkpoint_every == 0):
+                        checkpoint.save(step, jax.device_get(state))
+                    if on_step is not None:
+                        on_step(step)
+                    # Injection point AFTER the checkpoint write: a
+                    # preemption here models losing the gang between steps
+                    # — TPURunner classifies it retryable, restarts, and
+                    # this loop's resume path replays from the step just
+                    # saved (SURVEY.md §5.3).
+                    resilience.inject("preemption", step=step)
+                if on_epoch is not None:
+                    on_epoch(_epoch, state)
+        except BaseException:
+            # The gang is dying with async checkpoint writes possibly in
+            # flight. Flush them before unwinding so (a) the restarted
+            # attempt's latest_step() sees every step this attempt
+            # completed (no redone work) and (b) an abandoned async write
+            # can't race the restart's save of the same step.
+            if checkpoint is not None:
+                try:
+                    checkpoint.wait_until_finished()
+                except Exception:  # noqa: BLE001 - already unwinding
+                    pass
+            raise
         if checkpoint is not None:
             checkpoint.save(int(state.step), jax.device_get(state),
                             synchronous=True)

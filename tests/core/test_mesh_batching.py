@@ -61,3 +61,30 @@ def test_run_batched_concatenates():
 def test_run_batched_empty():
     out = run_batched(lambda b: b, np.zeros((0, 4), np.float32), 3)
     assert out.shape[0] == 0
+
+
+def test_host_local_mesh_warns_when_discarding_model_axis(monkeypatch, caplog):
+    """Substituting a data-only local mesh for a multi-host mesh with a
+    non-trivial model axis must WARN: parameter sharding is silently lost
+    otherwise and surfaces later as an inexplicable OOM (ADVICE r5)."""
+    import logging
+
+    from sparkdl_tpu.core import mesh as mesh_mod
+
+    full = make_mesh(MeshConfig(data=4, model=2))
+    monkeypatch.setattr(mesh_mod.jax, "process_count", lambda: 2)
+    monkeypatch.setattr(mesh_mod.jax, "local_devices",
+                        lambda: jax.devices()[:4])
+    with caplog.at_level(logging.WARNING, logger="sparkdl_tpu.core.mesh"):
+        local = mesh_mod.host_local_mesh(full)
+    assert local.shape["data"] == 4 and local.shape["model"] == 1
+    assert any("model" in r.message and "discard" in r.message
+               for r in caplog.records)
+
+    # a data-only mesh substitutes silently (nothing is lost)
+    caplog.clear()
+    data_only = make_mesh(MeshConfig(data=8))
+    with caplog.at_level(logging.WARNING, logger="sparkdl_tpu.core.mesh"):
+        local2 = mesh_mod.host_local_mesh(data_only)
+    assert local2.shape["data"] == 4
+    assert not caplog.records

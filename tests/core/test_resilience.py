@@ -1,0 +1,334 @@
+"""Resilience kernel: classifier, retry policy, deadlines, fault injection,
+and the OOM bucket-halving inference fallback (docs/RESILIENCE.md)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from sparkdl_tpu.core import batching
+from sparkdl_tpu.core.model_function import ModelFunction, TensorSpec
+from sparkdl_tpu.core.resilience import (
+    FATAL,
+    OOM,
+    RETRYABLE,
+    Deadline,
+    DeadlineExceeded,
+    DeviceOOM,
+    Fault,
+    FaultInjector,
+    Preemption,
+    RetryPolicy,
+    TransferStall,
+    classify,
+)
+
+
+# -- classifier --------------------------------------------------------------
+
+@pytest.mark.parametrize("err,kind", [
+    (ValueError("shape mismatch"), FATAL),
+    (TypeError("dtype"), FATAL),
+    (KeyError("col"), FATAL),
+    (DeadlineExceeded("too slow"), FATAL),
+    (RuntimeError("INVALID_ARGUMENT: bad program"), FATAL),
+    (DeviceOOM(), OOM),
+    (RuntimeError("RESOURCE_EXHAUSTED: out of memory allocating"), OOM),
+    (RuntimeError("Resource exhausted: HBM"), OOM),
+    (Preemption(), RETRYABLE),
+    (TransferStall(), RETRYABLE),
+    (RuntimeError("UNAVAILABLE: socket closed"), RETRYABLE),
+    (RuntimeError("something unprecedented"), RETRYABLE),  # gang default
+    (OSError("connection reset"), RETRYABLE),
+    # transient infra markers override a fatal wrapper type
+    (ValueError("UNAVAILABLE: socket closed mid-collective"), RETRYABLE),
+    # "OOM" matches as a word, not a substring
+    (RuntimeError("OOM while allocating 2.1GiB"), OOM),
+    # allocator prose matches case-insensitively
+    (RuntimeError("Out of memory while trying to allocate 8589934592 "
+                  "bytes"), OOM),
+    (RuntimeError("BLOOM shard failed to load"), RETRYABLE),
+    (ValueError("BLOOM config invalid"), FATAL),
+])
+def test_classify(err, kind):
+    assert classify(err) == kind
+
+
+# -- RetryPolicy -------------------------------------------------------------
+
+def test_retry_policy_deterministic_and_exponential():
+    a, b = RetryPolicy(seed=7), RetryPolicy(seed=7)
+    delays = [a.delay(i) for i in (1, 2, 3, 4)]
+    assert delays == [b.delay(i) for i in (1, 2, 3, 4)]  # deterministic
+    # exponential growth dominates jitter (jitter ≤ 50%, growth = 2x)
+    assert delays[1] > delays[0] and delays[3] > delays[1]
+    # different seeds give different jitter
+    assert RetryPolicy(seed=8).delay(1) != a.delay(1)
+    # no-jitter policy is exact
+    p = RetryPolicy(base_delay_s=1.0, multiplier=2.0, jitter=0.0,
+                    max_delay_s=5.0)
+    assert [p.delay(i) for i in (1, 2, 3, 4)] == [1.0, 2.0, 4.0, 5.0]
+    with pytest.raises(ValueError):
+        p.delay(0)
+
+
+def test_retry_policy_execute_retries_transient_only():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise TransferStall()
+        return "ok"
+
+    policy = RetryPolicy(max_retries=3, base_delay_s=0.0)
+    assert policy.execute(flaky, sleep=lambda d: None) == "ok"
+    assert len(calls) == 3
+
+    calls.clear()
+
+    def fatal():
+        calls.append(1)
+        raise ValueError("bad shape")
+
+    with pytest.raises(ValueError):
+        policy.execute(fatal, sleep=lambda d: None)
+    assert len(calls) == 1  # never retried
+
+    calls.clear()
+
+    def oom():
+        calls.append(1)
+        raise DeviceOOM()
+
+    with pytest.raises(DeviceOOM):  # OOM needs a smaller batch, not a retry
+        policy.execute(oom, sleep=lambda d: None)
+    assert len(calls) == 1
+
+
+def test_retry_policy_execute_exhaustion_raises_last_error():
+    def always():
+        raise Preemption()
+
+    with pytest.raises(Preemption):
+        RetryPolicy(max_retries=2, base_delay_s=0.0).execute(
+            always, sleep=lambda d: None)
+
+
+def test_retry_policy_execute_respects_deadline():
+    clock = [0.0]
+
+    def always():
+        clock[0] += 10.0
+        raise TransferStall()
+
+    deadline = Deadline(15.0, clock=lambda: clock[0])
+    with pytest.raises(DeadlineExceeded):
+        RetryPolicy(max_retries=10, base_delay_s=0.0).execute(
+            always, deadline=deadline, sleep=lambda d: None)
+
+
+# -- Deadline ----------------------------------------------------------------
+
+def test_deadline():
+    clock = [0.0]
+    d = Deadline(5.0, clock=lambda: clock[0])
+    assert d.remaining() == 5.0 and not d.expired()
+    d.check()
+    clock[0] = 6.0
+    assert d.expired()
+    with pytest.raises(DeadlineExceeded, match="deadline"):
+        d.check("thing")
+    assert Deadline(None).remaining() == float("inf")
+
+
+# -- FaultInjector -----------------------------------------------------------
+
+def test_injector_unknown_point_rejected():
+    with pytest.raises(ValueError, match="Unknown injection point"):
+        FaultInjector.seeded(0, not_a_point=1)
+
+
+def test_injector_fires_n_times_then_disarms():
+    from sparkdl_tpu.core import resilience
+
+    with FaultInjector.seeded(0, device_oom=2) as inj:
+        for _ in range(2):
+            with pytest.raises(DeviceOOM):
+                resilience.inject("device_oom")
+        resilience.inject("device_oom")  # disarmed: no raise
+        assert inj.fired["device_oom"] == 2
+    resilience.inject("device_oom")  # deactivated: no-op
+
+
+def test_injector_when_predicate_and_after():
+    from sparkdl_tpu.core import resilience
+
+    with FaultInjector.seeded(
+            0, preemption=Fault(when=lambda ctx: ctx.get("step") == 3)):
+        resilience.inject("preemption", step=1)
+        resilience.inject("preemption", step=2)
+        with pytest.raises(Preemption):
+            resilience.inject("preemption", step=3)
+    with FaultInjector.seeded(0, transfer_stall=Fault(after=2)) as inj:
+        resilience.inject("transfer_stall")
+        resilience.inject("transfer_stall")
+        with pytest.raises(TransferStall):
+            resilience.inject("transfer_stall")
+        assert inj.fired["transfer_stall"] == 1
+
+
+def test_injector_nested_activation_restores_previous():
+    from sparkdl_tpu.core import resilience
+
+    with FaultInjector.seeded(0, device_oom=5):
+        with FaultInjector.seeded(0, preemption=5):
+            resilience.inject("device_oom")  # inner masks outer: no raise
+            assert resilience.active_injector().faults.keys() == {"preemption"}
+        with pytest.raises(DeviceOOM):
+            resilience.inject("device_oom")
+    assert resilience.active_injector() is None
+
+
+def test_injector_visible_from_worker_threads():
+    """Process-wide by design: engine partition ops run on pool threads
+    where a ContextVar scope entered on the driver would be invisible."""
+    from sparkdl_tpu.core import resilience
+
+    hit = []
+
+    def worker():
+        try:
+            resilience.inject("device_oom")
+        except DeviceOOM:
+            hit.append(True)
+
+    with FaultInjector.seeded(0, device_oom=1):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    assert hit == [True]
+
+
+# -- run_batched: retry + OOM re-chunking ------------------------------------
+
+FAST = RetryPolicy(max_retries=2, base_delay_s=0.0)
+
+
+def test_run_batched_oom_rechunks_at_halved_bucket_identical_output():
+    calls = []
+
+    def fn(chunk):
+        calls.append(chunk.shape[0])
+        return chunk * 2.0
+
+    arr = np.arange(40, dtype=np.float32).reshape(20, 2)
+    baseline = batching.run_batched(fn, arr, batch_size=8)
+    calls.clear()
+    with FaultInjector.seeded(
+            0, device_oom=Fault(times=-1,
+                                when=lambda ctx: ctx["rows"] >= 8)) as inj:
+        out = batching.run_batched(fn, arr, batch_size=8, retry_policy=FAST)
+    np.testing.assert_array_equal(out, baseline)  # values AND row order
+    assert inj.fired["device_oom"] >= 2
+    assert calls and max(calls) <= 4  # every dispatch ran at ≤ half bucket
+
+
+def test_run_batched_transient_error_retries_same_chunk():
+    calls = []
+
+    def fn(chunk):
+        calls.append(chunk.shape[0])
+        return chunk + 1
+
+    arr = np.arange(10, dtype=np.float32).reshape(10, 1)
+    with FaultInjector.seeded(0, transfer_stall=1) as inj:
+        out = batching.run_batched(fn, arr, batch_size=4, retry_policy=FAST)
+    np.testing.assert_array_equal(out, arr + 1)
+    assert inj.fired["transfer_stall"] == 1
+
+
+def test_run_batched_fatal_error_propagates_unretried():
+    calls = []
+
+    def fn(chunk):
+        calls.append(1)
+        raise ValueError("bad dtype in program")
+
+    with pytest.raises(ValueError, match="bad dtype"):
+        batching.run_batched(fn, np.zeros((4, 1), np.float32), 4,
+                             retry_policy=FAST)
+    assert len(calls) == 1
+
+
+def test_run_batched_oom_at_minimal_bucket_exhausts_and_raises():
+    with FaultInjector.seeded(0, device_oom=Fault(times=-1)):
+        with pytest.raises(DeviceOOM):
+            # multiple=4 forbids halving below 4; bucket starts at 4
+            batching.run_batched(lambda c: c, np.zeros((4, 1), np.float32),
+                                 4, multiple=4, retry_policy=FAST)
+
+
+# -- apply_batch: the acceptance-criteria path -------------------------------
+
+def _linear_model():
+    w = jnp.arange(6.0).reshape(3, 2)
+    return ModelFunction.fromFunction(lambda vs, x: x @ vs, w,
+                                      TensorSpec((None, 3)))
+
+
+def test_apply_batch_injected_oom_halves_bucket_and_is_bit_identical():
+    """Acceptance: under injected device_oom at the initial bucket size,
+    apply_batch retries at a halved bucket and returns results identical
+    (same values, same row order) to an uninjected run."""
+    mf = _linear_model()
+    rng = np.random.default_rng(42)
+    arr = rng.normal(size=(50, 3)).astype(np.float32)
+    baseline = mf.apply_batch(arr, batch_size=16)
+    with FaultInjector.seeded(0, device_oom=1) as inj:
+        out = mf.apply_batch(arr, batch_size=16)
+    assert inj.fired["device_oom"] == 1
+    assert np.array_equal(np.asarray(baseline), np.asarray(out))
+
+
+def test_apply_batch_fatal_error_not_retried():
+    calls = []
+
+    def bad(vs, x):
+        calls.append(1)
+        raise ValueError("deliberate shape error")
+
+    mf = ModelFunction.fromFunction(bad, None, TensorSpec((None, 3)))
+    with pytest.raises(ValueError, match="deliberate"):
+        mf.apply_batch(np.zeros((4, 3), np.float32), batch_size=4)
+    assert len(calls) == 1
+
+
+def test_apply_batch_outer_oom_fallback_halves_batch_size():
+    """An OOM surfacing outside per-chunk dispatch (e.g. at the deferred
+    fetch) re-runs the whole call at a halved batch_size."""
+    mf = _linear_model()
+    arr = np.arange(24, dtype=np.float32).reshape(8, 3)
+    baseline = mf.apply_batch(arr, batch_size=8)
+
+    seen = []
+    original = batching.run_batched
+
+    def oom_once(fn, tree, batch_size, **kw):
+        seen.append(batch_size)
+        if len(seen) == 1:
+            raise RuntimeError("RESOURCE_EXHAUSTED: while fetching outputs")
+        return original(fn, tree, batch_size, **kw)
+
+    import sparkdl_tpu.core.model_function as mfmod
+
+    orig = mfmod.batching.run_batched
+    mfmod.batching.run_batched = oom_once
+    try:
+        out = mf.apply_batch(arr, batch_size=8)
+    finally:
+        mfmod.batching.run_batched = orig
+    assert seen == [8, 4]
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(baseline))

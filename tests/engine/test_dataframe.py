@@ -519,3 +519,24 @@ def test_join_on_nested_key():
     right = DataFrame.fromRows([{"k": [1, 2], "y": 1.0}])
     out = left.join(right, on="k").collect()
     assert out == [{"k": [1, 2], "x": "a", "y": 1.0}]
+
+
+def test_eval_bool_short_circuits():
+    """AND/OR stop at the first deciding operand: the right side references
+    a column missing from the env, so evaluating it would KeyError."""
+    from sparkdl_tpu.engine import sql_expr
+
+    and_node = sql_expr.parse_bool("a = 1 AND missing = 2")
+    assert sql_expr.eval_bool(and_node, {"a": 2}) is False  # no KeyError
+    or_node = sql_expr.parse_bool("a = 1 OR missing = 2")
+    assert sql_expr.eval_bool(or_node, {"a": 1}) is True
+    # an undecided AND/OR must still evaluate everything
+    with pytest.raises(KeyError):
+        sql_expr.eval_bool(and_node, {"a": 1})
+    # SQL UNKNOWN semantics preserved after the rewrite
+    null_and = sql_expr.parse_bool("a = 1 AND b = 2")
+    assert sql_expr.eval_bool(null_and, {"a": None, "b": 2}) is None
+    assert sql_expr.eval_bool(null_and, {"a": None, "b": 3}) is False
+    null_or = sql_expr.parse_bool("a = 1 OR b = 2")
+    assert sql_expr.eval_bool(null_or, {"a": None, "b": 2}) is True
+    assert sql_expr.eval_bool(null_or, {"a": None, "b": 3}) is None

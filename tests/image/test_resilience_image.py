@@ -1,0 +1,81 @@
+"""Data-plane degradation: corrupt/undecodable rows become nulls, the
+partition completes, and drops are surfaced (docs/RESILIENCE.md)."""
+
+import numpy as np
+
+from sparkdl_tpu.core.resilience import Fault, FaultInjector
+from sparkdl_tpu.image import imageIO
+
+
+def _struct(rng, h=8, w=8):
+    return imageIO.imageArrayToStruct(
+        rng.integers(0, 255, (h, w, 3), dtype=np.uint8))
+
+
+def test_tolerant_staging_drops_corrupt_rows_keeps_order(rng):
+    structs = [_struct(rng) for _ in range(6)]
+    structs[1] = dict(structs[1], data=structs[1]["data"][:7])  # truncated
+    structs[4] = dict(structs[4], mode=99)  # unknown OpenCV code
+    batch, kept, dropped = imageIO.imageStructsToBatchArrayTolerant(
+        structs, dtype=None)
+    assert kept == [0, 2, 3, 5] and dropped == 2
+    for j, i in enumerate(kept):
+        np.testing.assert_array_equal(
+            batch[j], imageIO.imageStructToArray(structs[i]))
+
+
+def test_tolerant_staging_all_corrupt_returns_empty(rng):
+    structs = [dict(_struct(rng), mode=99) for _ in range(3)]
+    batch, kept, dropped = imageIO.imageStructsToBatchArrayTolerant(
+        structs, target_size=(8, 8))
+    assert kept == [] and dropped == 3
+    assert batch.shape == (0, 8, 8, 3)
+
+
+def test_tolerant_staging_matches_strict_on_clean_input(rng):
+    structs = [_struct(rng) for _ in range(4)]
+    strict = imageIO.imageStructsToBatchArray(structs, dtype="float32")
+    tolerant, kept, dropped = imageIO.imageStructsToBatchArrayTolerant(
+        structs, dtype="float32")
+    assert dropped == 0 and kept == [0, 1, 2, 3]
+    np.testing.assert_array_equal(strict, tolerant)
+
+
+def test_decode_error_injection_in_decode_bytes(tmp_path, rng):
+    from PIL import Image
+
+    p = tmp_path / "img.png"
+    Image.fromarray(
+        rng.integers(0, 255, (8, 8, 3), dtype=np.uint8)).save(p)
+    data = p.read_bytes()
+    assert imageIO.decodeImageBytes(data) is not None
+    with FaultInjector.seeded(0, decode_error=1) as inj:
+        assert imageIO.decodeImageBytes(data) is None
+        assert imageIO.decodeImageBytes(data) is not None  # disarmed
+    assert inj.fired["decode_error"] == 1
+
+
+def test_decode_error_injection_in_batch_decode(tmp_path, rng):
+    from PIL import Image
+
+    blobs = []
+    for i in range(4):
+        p = tmp_path / f"b{i}.png"
+        Image.fromarray(
+            rng.integers(0, 255, (8, 8, 3), dtype=np.uint8)).save(p)
+        blobs.append(p.read_bytes())
+    with FaultInjector.seeded(0, decode_error=Fault(after=1, times=1)):
+        out = imageIO.decodeImageBytesBatch(blobs, (8, 8))
+    assert [o is None for o in out] == [False, True, False, False]
+
+
+def test_read_images_with_injected_decode_error(tiny_image_dir):
+    """readImages degrades injected-undecodable files to null structs —
+    the partition (and the read) completes."""
+    baseline = imageIO.readImages(str(tiny_image_dir)).collect()
+    n_ok = sum(r["image"] is not None for r in baseline)
+    assert n_ok >= 2
+    with FaultInjector.seeded(0, decode_error=1):
+        rows = imageIO.readImages(str(tiny_image_dir)).collect()
+    assert len(rows) == len(baseline)
+    assert sum(r["image"] is not None for r in rows) == n_ok - 1

@@ -415,3 +415,45 @@ def test_regression_evaluator_large_mean_r2():
     r2 = RegressionEvaluator(metricName="r2").evaluate(df)
     # SStot = 2.0, SSres = 3 * 0.01 -> r2 = 1 - 0.03/2
     assert r2 == pytest.approx(1.0 - 0.03 / 2.0, rel=1e-6)
+
+
+def test_grid_param_name_collision_rejected_at_save(tmp_path):
+    """A foreign param whose NAME collides with one the estimator owns
+    must be rejected by identity at save — resolving it by name on load
+    would silently rebind the grid to the estimator's param (ADVICE r5)."""
+    from sparkdl_tpu.param.base import Param, Params
+
+    class Foreign(Params):
+        maxIter = Param("Foreign", "maxIter", "colliding name")
+
+    lr = LogisticRegression()
+    bad_grid = [{Foreign().maxIter: 5}]
+    cv = CrossValidator(estimator=lr, estimatorParamMaps=bad_grid,
+                        evaluator=MulticlassClassificationEvaluator(),
+                        numFolds=2)
+    with pytest.raises(ValueError, match="collides"):
+        cv.save(str(tmp_path / "collide"))
+    # the estimator's own param still persists fine
+    ok = CrossValidator(
+        estimator=lr,
+        estimatorParamMaps=[{lr.maxIter: 5}],
+        evaluator=MulticlassClassificationEvaluator(), numFolds=2)
+    ok.save(str(tmp_path / "ok"))
+
+
+def test_binary_evaluator_aupr_anchors_at_first_precision():
+    """Spark parity: the PR curve starts at (0, firstPrecision), not an
+    optimistic (0, 1.0) — visible when the top threshold group holds a
+    tie between a positive and a negative (ADVICE r5)."""
+    from sparkdl_tpu.ml import BinaryClassificationEvaluator
+
+    # scores desc: {0.5: (+,-)} {0.2: +} {0.1: -}   P=2 N=2
+    # curve (rec, prec): (.5, .5) (1, 2/3) (1, .5); anchor (0, .5)
+    # trapezoid: 0→.5: .5*.5=.25 ; .5→1: avg(.5,2/3)*.5=7/24 -> 13/24
+    # (the old (0,1.0) anchor would give .375 + 7/24 = 2/3)
+    rows = [{"rawPrediction": s, "label": l} for s, l in
+            [(0.5, 1), (0.5, 0), (0.2, 1), (0.1, 0)]]
+    df = DataFrame.fromRows(rows)
+    aupr = BinaryClassificationEvaluator(
+        metricName="areaUnderPR").evaluate(df)
+    assert aupr == pytest.approx(13 / 24)

@@ -1,0 +1,243 @@
+"""Gang-restart resilience: classified restarts, checkpoint-resumed
+preemption recovery, corrupt-checkpoint fallback (docs/RESILIENCE.md)."""
+
+import logging
+
+import numpy as np
+import pytest
+import jax
+
+import flax.linen as nn
+
+from sparkdl_tpu.core.resilience import Fault, FaultInjector, RetryPolicy
+from sparkdl_tpu.train import CheckpointManager, TPURunner, Trainer
+
+
+class MLP(nn.Module):
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return jax.nn.softmax(nn.Dense(3)(nn.relu(nn.Dense(8)(x))), axis=-1)
+
+
+def _data(n=32, d=4):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+    return [(x[i:i + 8], y[i:i + 8]) for i in range(0, n, 8)]
+
+
+@pytest.fixture
+def module_and_vars():
+    module = MLP()
+    variables = module.init(jax.random.PRNGKey(0),
+                            np.zeros((1, 4), np.float32))
+    return module, variables
+
+
+def test_injected_preemption_resumes_from_latest_checkpoint(
+        tmp_path, module_and_vars):
+    """Acceptance: TPURunner(max_restarts≥1) with an injected mid-training
+    preemption resumes from the latest checkpoint step — the executed-step
+    trace shows no redone steps (checkpoint_every=1 ⇒ zero lost work)."""
+    module, variables = module_and_vars
+    batches = _data()
+    steps_run, attempts = [], []
+
+    def train_fn(mesh=None):
+        attempts.append(1)
+        trainer, state = Trainer.from_flax(module, variables,
+                                           optimizer="sgd",
+                                           learning_rate=0.1, mesh=mesh)
+        ckpt = CheckpointManager(str(tmp_path / "gang"))
+        state = trainer.fit(state, batches, epochs=2, checkpoint=ckpt,
+                            checkpoint_every=1,
+                            on_step=steps_run.append)
+        ckpt.wait_until_finished()
+        ckpt.close()
+        return int(state.step)
+
+    with FaultInjector.seeded(
+            0, preemption=Fault(when=lambda ctx: ctx["step"] == 3)) as inj:
+        final = TPURunner(np=2, max_restarts=2).run(train_fn)
+    assert final == 8
+    assert inj.fired["preemption"] == 1
+    assert len(attempts) == 2  # one preemption, one successful restart
+    # the restart resumed AT the checkpoint: every step executed once
+    assert steps_run == [1, 2, 3, 4, 5, 6, 7, 8]
+
+
+def test_preemption_with_sparse_checkpoints_redoes_at_most_interval(
+        tmp_path, module_and_vars):
+    """checkpoint_every=2 + preemption at step 3: the restart resumes from
+    step 2, so only step 3 is recomputed — bounded by the interval."""
+    module, variables = module_and_vars
+    batches = _data()
+    steps_run = []
+
+    def train_fn(mesh=None):
+        trainer, state = Trainer.from_flax(module, variables,
+                                           optimizer="sgd",
+                                           learning_rate=0.1, mesh=mesh)
+        ckpt = CheckpointManager(str(tmp_path / "gang2"))
+        state = trainer.fit(state, batches, epochs=2, checkpoint=ckpt,
+                            checkpoint_every=2,
+                            on_step=steps_run.append)
+        ckpt.wait_until_finished()
+        ckpt.close()
+        return int(state.step)
+
+    with FaultInjector.seeded(
+            0, preemption=Fault(when=lambda ctx: ctx["step"] == 3)):
+        final = TPURunner(np=2, max_restarts=1).run(train_fn)
+    assert final == 8
+    assert steps_run == [1, 2, 3, 3, 4, 5, 6, 7, 8]  # exactly one redo
+
+
+def test_fatal_error_raises_without_restart():
+    """Acceptance: a fatal ValueError from the train fn is raised
+    unwrapped, with zero restart attempts."""
+    attempts = []
+
+    def train_fn(mesh=None):
+        attempts.append(1)
+        raise ValueError("label shape (8, 4) does not match logits (8, 3)")
+
+    with pytest.raises(ValueError, match="label shape"):
+        TPURunner(np=2, max_restarts=3).run(train_fn)
+    assert len(attempts) == 1
+
+
+def test_runner_backoff_uses_policy_delays(monkeypatch):
+    slept = []
+    monkeypatch.setattr("sparkdl_tpu.train.runner.time.sleep", slept.append)
+    policy = RetryPolicy(max_retries=2, base_delay_s=1.0, jitter=0.0)
+
+    def always_fail(mesh=None):
+        raise RuntimeError("worker lost")
+
+    with pytest.raises(RuntimeError, match="after 3 attempts"):
+        TPURunner(np=2, max_restarts=2, retry_policy=policy).run(always_fail)
+    assert slept == [1.0, 2.0]  # exponential, not fixed
+
+
+# -- checkpoint corruption ---------------------------------------------------
+
+def _fit_with_checkpoints(tmp_path, module_and_vars, name="ck",
+                          injector_ctx=None):
+    module, variables = module_and_vars
+    trainer, state = Trainer.from_flax(module, variables, optimizer="sgd",
+                                       learning_rate=0.1)
+    ckpt = CheckpointManager(str(tmp_path / name))
+    state = trainer.fit(state, _data(), epochs=1, checkpoint=ckpt,
+                        checkpoint_every=1)
+    ckpt.wait_until_finished()
+    return ckpt, jax.device_get(state)
+
+
+def test_corrupt_latest_checkpoint_falls_back_with_warning(
+        tmp_path, module_and_vars, caplog):
+    """Acceptance: a truncated latest checkpoint restores from the
+    previous retained step, warning names the skipped step."""
+    ckpt, state = _fit_with_checkpoints(tmp_path, module_and_vars)
+    assert ckpt.all_steps() == [2, 3, 4]
+    ckpt._truncate_step(4)
+    with caplog.at_level(logging.WARNING, logger="sparkdl_tpu.train.checkpoint"):
+        restored = ckpt.restore(state)
+    assert int(restored.step) == 3
+    assert any("step 4" in r.message and "falling back" in r.message
+               for r in caplog.records)
+    ckpt.close()
+
+
+def test_checkpoint_truncate_injection_point(tmp_path, module_and_vars,
+                                             caplog):
+    """The checkpoint_truncate fault corrupts a COMMITTED save; restore
+    degrades to the previous step instead of raising."""
+    module, variables = module_and_vars
+    trainer, state = Trainer.from_flax(module, variables, optimizer="sgd",
+                                       learning_rate=0.1)
+    ckpt = CheckpointManager(str(tmp_path / "inj"))
+    host = jax.device_get(state)
+    ckpt.save(1, host, synchronous=True)
+    with FaultInjector.seeded(0, checkpoint_truncate=1) as inj:
+        ckpt.save(2, host, synchronous=True)
+    assert inj.fired["checkpoint_truncate"] == 1
+    with caplog.at_level(logging.WARNING):
+        restored = ckpt.restore(host)
+    assert int(restored.step) == int(host.step)  # step-1 copy restored
+    assert any("falling back to step 1" in r.message
+               for r in caplog.records)
+    ckpt.close()
+
+
+def test_save_over_existing_step_overwrites(tmp_path, module_and_vars):
+    """Re-saving a step that already exists on disk (gang restart replay,
+    or replay past a corrupt copy) must actually overwrite — Orbax would
+    otherwise silently skip it (should_save() false) and a corrupt latest
+    step would live forever."""
+    ckpt, state = _fit_with_checkpoints(tmp_path, module_and_vars,
+                                        name="overwrite")
+    latest = ckpt.latest_step()
+    ckpt._truncate_step(latest)
+    ckpt.close()
+    # a restarted gang opens a FRESH manager over the same directory
+    ckpt2 = CheckpointManager(str(tmp_path / "overwrite"))
+    with pytest.raises(Exception):
+        ckpt2.restore(state, step=latest)  # corrupt: direct restore fails
+    ckpt2.save(latest, state, synchronous=True)  # recomputed replay re-saves
+    restored = ckpt2.restore(state, step=latest)  # now restores cleanly
+    assert int(restored.step) == int(state.step)
+    ckpt2.close()
+
+
+def test_all_checkpoints_corrupt_raises(tmp_path, module_and_vars):
+    ckpt, state = _fit_with_checkpoints(tmp_path, module_and_vars,
+                                        name="allbad")
+    for step in ckpt.all_steps():
+        ckpt._truncate_step(step)
+    with pytest.raises(Exception):
+        ckpt.restore(state)
+    ckpt.close()
+
+
+def test_explicit_step_restore_does_not_fall_back(tmp_path, module_and_vars):
+    ckpt, state = _fit_with_checkpoints(tmp_path, module_and_vars,
+                                        name="explicit")
+    ckpt._truncate_step(4)
+    with pytest.raises(Exception):
+        ckpt.restore(state, step=4)
+    ckpt.close()
+
+
+def test_resume_after_preemption_matches_uninterrupted_run(
+        tmp_path, module_and_vars):
+    """End-to-end determinism: preempted+resumed training produces the
+    same final params as an uninterrupted run (exact replay of the batch
+    stream from the checkpointed step)."""
+    module, variables = module_and_vars
+    batches = _data()
+
+    def run(ckpt_dir, inject):
+        def train_fn(mesh=None):
+            trainer, state = Trainer.from_flax(module, variables,
+                                               optimizer="sgd",
+                                               learning_rate=0.1, mesh=mesh)
+            ckpt = CheckpointManager(ckpt_dir)
+            state = trainer.fit(state, batches, epochs=1, checkpoint=ckpt,
+                                checkpoint_every=1)
+            ckpt.wait_until_finished()
+            ckpt.close()
+            return jax.device_get(state)
+
+        if inject:
+            with FaultInjector.seeded(
+                    0, preemption=Fault(when=lambda c: c["step"] == 2)):
+                return TPURunner(np=2, max_restarts=1).run(train_fn)
+        return TPURunner(np=2).run(train_fn)
+
+    plain = run(str(tmp_path / "plain"), inject=False)
+    resumed = run(str(tmp_path / "preempted"), inject=True)
+    for a, b in zip(jax.tree.leaves(plain.params),
+                    jax.tree.leaves(resumed.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
