@@ -11,7 +11,9 @@ from sparkdl_tpu.core.mesh import (
 )
 from sparkdl_tpu.core.model_function import ModelFunction, InputModel, TensorSpec
 from sparkdl_tpu.core import batching
+from sparkdl_tpu.core import health
 from sparkdl_tpu.core import resilience
+from sparkdl_tpu.core.health import HealthMonitor
 from sparkdl_tpu.core.resilience import (
     Deadline, Fault, FaultInjector, RetryPolicy, classify,
 )
@@ -21,6 +23,7 @@ __all__ = [
     "MeshConfig", "make_mesh", "data_parallel_mesh", "batch_sharding",
     "replicated", "shard_batch",
     "ModelFunction", "InputModel", "TensorSpec",
-    "batching", "resilience",
-    "Deadline", "Fault", "FaultInjector", "RetryPolicy", "classify",
+    "batching", "health", "resilience",
+    "Deadline", "Fault", "FaultInjector", "HealthMonitor", "RetryPolicy",
+    "classify",
 ]

@@ -15,7 +15,7 @@ from typing import Callable, Iterator, List, Optional, Tuple
 
 import numpy as np
 
-from sparkdl_tpu.core import resilience
+from sparkdl_tpu.core import health, resilience
 
 logger = logging.getLogger(__name__)
 
@@ -139,13 +139,18 @@ def _dispatch_chunk(fn: Callable, chunk, n_valid: int,
         return [(fn(chunk), n_valid)]  # dispatched async; no block here
 
     try:
-        return policy.execute(attempt, what=f"chunk dispatch (bucket {rows})")
+        return policy.execute(
+            attempt, what=f"chunk dispatch (bucket {rows})",
+            on_retry=lambda a, e: health.record(
+                health.CHUNK_RETRY, bucket=rows, attempt=a,
+                error=type(e).__name__))
     except Exception as e:  # noqa: BLE001 - classified below
         if resilience.classify(e) != resilience.OOM:
             raise
         half = rows // 2
         if half < max(1, multiple):
             raise
+        health.record(health.OOM_RECHUNK, bucket=rows, half=half)
         logger.warning(
             "device OOM at bucket %d (%s); re-chunking %d valid "
             "row(s) at bucket %d", rows, e, n_valid, half)

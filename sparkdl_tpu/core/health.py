@@ -1,0 +1,174 @@
+"""Run-health telemetry: counters and events for the supervision layers.
+
+Every resilience mechanism in the framework (engine task retry/hedging/
+quarantine, the batching layer's OOM re-chunking, TPURunner gang restarts,
+Trainer checkpoint resumes, data-plane decode degradation) reports what it
+did into one :class:`HealthMonitor`, so a run's operator can answer "what
+actually went wrong, and what did the framework do about it?" from a
+single structured report instead of grepping warnings.
+
+Scoping mirrors :class:`~sparkdl_tpu.core.resilience.FaultInjector`:
+monitors activate process-wide (engine partition ops run on pool threads
+where a ContextVar scope entered on the driver would be invisible), nest,
+and restore the previous monitor on exit. With no active monitor,
+:func:`record` is a single global read + ``None`` check — the hot paths
+pay nothing when nobody is listening.
+
+Dependency-free by design (stdlib only): every layer may import it
+without cycles.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+# Canonical event names fed by the framework's own layers. Callers may
+# record arbitrary additional events; these are the ones the docs and the
+# chaos suite key off.
+TASK_STARTED = "task_started"            # engine: a partition task began
+TASK_RETRIED = "task_retried"            # engine: classified-retryable retry
+TASK_FAILED = "task_failed"              # engine: terminal task failure
+TASK_HEDGED = "task_hedged"              # engine: straggler duplicate launched
+HEDGE_WON = "hedge_won"                  # engine: the duplicate finished first
+TASK_QUARANTINED = "task_quarantined"    # engine: poisoned partition dropped
+TASK_DEADLINE_EXCEEDED = "task_deadline_exceeded"  # engine: watchdog fired
+CHUNK_RETRY = "chunk_retry"              # batching: transient chunk retry
+OOM_RECHUNK = "oom_rechunk"              # batching: bucket-halving fallback
+GANG_RESTART = "gang_restart"            # runner: classified gang restart
+GANG_FATAL = "gang_fatal"                # runner: fatal/OOM raise, no restart
+GANG_FAILED = "gang_failed"              # runner: restart budget exhausted
+FIT_RESUMED = "fit_resumed"              # trainer: resumed from a checkpoint
+FIT_COMPLETED = "fit_completed"          # trainer: fit loop finished
+DECODE_DEGRADED = "decode_degraded"      # data plane: row degraded to null
+
+
+class HealthMonitor:
+    """Thread-safe per-run counters + a bounded structured event log.
+
+    ::
+
+        with HealthMonitor("nightly-fit") as mon:
+            pipeline.run()
+        report = mon.report()          # {'counters': {...}, ...}
+        assert mon.count("task_retried") == 1
+
+    Counters are unbounded (one int per event name); the event log keeps
+    the first ``max_events`` events with their context kwargs and counts
+    the overflow, so a pathological retry storm cannot exhaust memory.
+    """
+
+    def __init__(self, name: str = "run", max_events: int = 2048) -> None:
+        self.name = name
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {}
+        self._events: List[Dict[str, Any]] = []
+        self._max_events = max_events
+        self._dropped_events = 0
+        self._prev: Optional["HealthMonitor"] = None
+
+    # -- recording -----------------------------------------------------------
+
+    def record(self, event: str, n: int = 1, **ctx: Any) -> None:
+        """Count ``event`` (``n`` occurrences) and log one context entry."""
+        with self._lock:
+            self._counters[event] = self._counters.get(event, 0) + n
+            if len(self._events) < self._max_events:
+                entry: Dict[str, Any] = {"event": event}
+                if n != 1:
+                    entry["n"] = n
+                entry.update(ctx)
+                self._events.append(entry)
+            else:
+                self._dropped_events += 1
+
+    # -- querying ------------------------------------------------------------
+
+    def count(self, event: str) -> int:
+        with self._lock:
+            return self._counters.get(event, 0)
+
+    def counters(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counters)
+
+    def events(self, event: Optional[str] = None) -> List[Dict[str, Any]]:
+        with self._lock:
+            if event is None:
+                return list(self._events)
+            return [e for e in self._events if e["event"] == event]
+
+    def quarantined(self) -> List[Dict[str, Any]]:
+        """The quarantine registry: one entry per dropped partition."""
+        return self.events(TASK_QUARANTINED)
+
+    def report(self) -> Dict[str, Any]:
+        """The per-run health report (structured, JSON-able)."""
+        with self._lock:
+            return {
+                "run": self.name,
+                "counters": dict(sorted(self._counters.items())),
+                "quarantined": [e for e in self._events
+                                if e["event"] == TASK_QUARANTINED],
+                "events_recorded": len(self._events),
+                "events_dropped": self._dropped_events,
+            }
+
+    def log_report(self, level: int = logging.INFO) -> None:
+        rep = self.report()
+        if not rep["counters"]:
+            logger.log(level, "health report for %r: no events recorded",
+                       self.name)
+            return
+        counters = ", ".join(f"{k}={v}" for k, v in rep["counters"].items())
+        logger.log(level, "health report for %r: %s (%d event(s) recorded"
+                   "%s)", self.name, counters, rep["events_recorded"],
+                   f", {rep['events_dropped']} dropped"
+                   if rep["events_dropped"] else "")
+
+    # -- activation ----------------------------------------------------------
+
+    def __enter__(self) -> "HealthMonitor":
+        global _active
+        with _activation_lock:
+            self._prev = _active
+            _active = self
+        return self
+
+    def __exit__(self, *exc) -> None:
+        global _active
+        with _activation_lock:
+            _active = self._prev
+            self._prev = None
+        # Job-end hook: one report per run, when the monitor deactivates
+        # (NOT per Trainer.fit — an HPO search runs dozens of fits under
+        # one monitor and cumulative counters would mislead per fit).
+        if self._counters:
+            self.log_report()
+
+
+_active: Optional[HealthMonitor] = None
+_activation_lock = threading.Lock()
+
+
+def active_monitor() -> Optional[HealthMonitor]:
+    return _active
+
+
+def record(event: str, n: int = 1, **ctx: Any) -> None:
+    """Record into the active monitor (no-op — one global read — without
+    one)."""
+    mon = _active
+    if mon is not None:
+        mon.record(event, n=n, **ctx)
+
+
+def log_report(level: int = logging.INFO) -> None:
+    """Log the active monitor's report (no-op without one) — the
+    job-end hook ``Trainer.fit`` and long pipelines call."""
+    mon = _active
+    if mon is not None:
+        mon.log_report(level)

@@ -77,6 +77,15 @@ class TransferStall(InjectedFault):
         super().__init__(msg)
 
 
+class WorkerFault(InjectedFault):
+    """Simulated engine worker/task failure (a partition task dying mid-run
+    or after computing but before delivering its result — RETRYABLE)."""
+
+    def __init__(self, msg: str = "injected worker fault: partition task "
+                 "lost (UNAVAILABLE)") -> None:
+        super().__init__(msg)
+
+
 class DeadlineExceeded(RuntimeError):
     """A :class:`Deadline` expired before the guarded work completed."""
 
@@ -120,7 +129,15 @@ def classify(err: BaseException) -> str:
     has always retried unknown errors (Spark task semantics) and a
     spurious retry is bounded by the policy, while a missed retry loses
     the job.
+
+    An exception carrying a ``failure_kind`` attribute (the engine's
+    ``TaskFailure``, which records its terminal attempt's classification)
+    is trusted verbatim — a task that failed FATALLY must stay fatal
+    through every wrapper, or a gang restart would replay it.
     """
+    kind = getattr(err, "failure_kind", None)
+    if kind in (FATAL, OOM, RETRYABLE):
+        return kind
     if isinstance(err, DeviceOOM):
         return OOM
     if isinstance(err, (Preemption, TransferStall)):
@@ -269,6 +286,15 @@ INJECTION_POINTS: Dict[str, Tuple[str, Optional[Callable[[], BaseException]]]] =
     "checkpoint_truncate": ("behavioral: CheckpointManager.save corrupts "
                             "the just-written step — exercises restore "
                             "fallback to the previous retained step", None),
+    "engine_task": ("raised per partition-task attempt in the engine "
+                    "executor (engine/dataframe); ctx carries partition, "
+                    "attempt, and phase ('start' before the op chain, "
+                    "'finish' after it — a worker dying before delivering "
+                    "its computed result) — exercises classified task "
+                    "retry", WorkerFault),
+    "task_stall": ("behavioral: the engine partition task hangs (sleeps "
+                   "past its deadline) instead of failing — exercises the "
+                   "supervisor's deadline watchdog", None),
 }
 
 

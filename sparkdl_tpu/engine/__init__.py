@@ -11,5 +11,11 @@ from sparkdl_tpu.engine.dataframe import (
     sql,
     table,
 )
+from sparkdl_tpu.engine.supervisor import (
+    PartitionSupervisor,
+    SupervisorConfig,
+    TaskAttempt,
+)
 
-__all__ = ["DataFrame", "EngineConfig", "TaskFailure", "sql", "table"]
+__all__ = ["DataFrame", "EngineConfig", "TaskFailure", "TaskAttempt",
+           "PartitionSupervisor", "SupervisorConfig", "sql", "table"]

@@ -9,10 +9,14 @@ for the TPU data path rather than translated from Spark:
 - **Lazy plans**: transformations append ops to a plan; ``collect`` /
   ``toArrow`` / transformer execution materialize partition-by-partition in
   one pass (op fusion per partition, like Spark's pipelined narrow stages).
-- **Partition-parallel execution with retry**: a thread pool maps partitions
-  with bounded retry — the engine-level analog of Spark task retry
-  (SURVEY.md §5.3). Ops must be pure/idempotent, which every op built by
-  this framework is.
+- **Partition-parallel execution with supervision**: a thread pool maps
+  partitions under task-level supervision (``engine/supervisor.py``) — the
+  engine analog of Spark task retry/speculation (SURVEY.md §5.3):
+  failures are classified through ``core.resilience`` (FATAL never
+  retried, RETRYABLE backed off, OOM surfaced), hung tasks fail via a
+  deadline watchdog, stragglers can be speculatively hedged, and poisoned
+  partitions can be quarantined. Ops must be pure/idempotent, which every
+  op built by this framework is.
 - **No JVM, no shuffle**: the workloads this framework serves (per-row model
   application, featurize, fit) are narrow; wide shuffles are out of scope,
   matching the reference's actual usage of Spark.
@@ -30,19 +34,71 @@ import numpy as np
 import pandas as pd
 import pyarrow as pa
 
+from sparkdl_tpu.core import resilience
+from sparkdl_tpu.engine import supervisor as _sup
+from sparkdl_tpu.engine.supervisor import (  # noqa: F401 - re-exported API
+    PartitionSupervisor,
+    SupervisorConfig,
+    TaskAttempt,
+    TaskFailure,
+)
+
 
 class EngineConfig:
     """Engine-wide knobs (no globals beyond this explicit, test-overridable one)."""
 
+    # -- task retry (engine/supervisor.run_partition_task) -------------------
     max_task_retries: int = 2
+    # Backoff between retryable attempts; 0 keeps the historical
+    # retry-immediately behavior. task_retry_policy overrides both.
+    task_retry_delay_s: float = 0.0
+    task_retry_policy: Optional[resilience.RetryPolicy] = None
+    # -- deadline watchdog ----------------------------------------------------
+    # Per-task wall-clock budget (seconds); None disables. Enforced
+    # cooperatively inside the task and preemptively by the supervisor's
+    # watchdog, so a hung op fails the task instead of wedging the run.
+    task_timeout_s: Optional[float] = None
+    # -- speculative execution (straggler hedging) ----------------------------
+    # Off by default (Spark's spark.speculation default): hedging re-runs
+    # ops, which must be pure — results are identical, but op side effects
+    # (counters in tests) would double.
+    speculation: bool = False
+    speculation_quantile: float = 0.75
+    speculation_multiplier: float = 1.5
+    speculation_min_runtime_s: float = 0.05
+    # -- quarantine (opt-in skip-and-degrade) ---------------------------------
+    # Drop a partition that fails FATALLY (after quarantine_max_fatal
+    # classified-fatal attempts) instead of failing the job: a zero-row
+    # batch with the op chain's output schema stands in, and the drop is
+    # recorded in the active HealthMonitor.
+    quarantine: bool = False
+    quarantine_max_fatal: int = 1
     max_workers: int = max(2, (os.cpu_count() or 4) // 2)
-    # Test hook (SURVEY.md §5.3 fault injection): callable(partition_index,
-    # attempt) that may raise to simulate a task failure.
+    # DEPRECATED test hook (SURVEY.md §5.3 fault injection):
+    # callable(partition_index, attempt) that may raise to simulate a task
+    # failure. Kept as a compat shim — new code arms the unified
+    # resilience.FaultInjector "engine_task" / "task_stall" points, which
+    # share the injector's seeding story.
     fault_injector: Optional[Callable[[int, int], None]] = None
 
 
-class TaskFailure(RuntimeError):
-    """A partition task failed after exhausting retries."""
+def _task_policy() -> resilience.RetryPolicy:
+    if EngineConfig.task_retry_policy is not None:
+        return EngineConfig.task_retry_policy
+    return resilience.RetryPolicy(
+        max_retries=EngineConfig.max_task_retries,
+        base_delay_s=EngineConfig.task_retry_delay_s, jitter=0.0)
+
+
+def _supervisor_config() -> SupervisorConfig:
+    return SupervisorConfig(
+        task_timeout_s=EngineConfig.task_timeout_s,
+        speculation=EngineConfig.speculation,
+        speculation_quantile=EngineConfig.speculation_quantile,
+        speculation_multiplier=EngineConfig.speculation_multiplier,
+        speculation_min_runtime_s=EngineConfig.speculation_min_runtime_s,
+        quarantine=EngineConfig.quarantine,
+        quarantine_max_fatal=EngineConfig.quarantine_max_fatal)
 
 
 # Process-wide partition executor, reused across materializations (VERDICT
@@ -67,23 +123,20 @@ def _executor() -> _futures.ThreadPoolExecutor:
 
 
 def _run_partition(index: int, batch: pa.RecordBatch,
-                   ops: Sequence[Callable[[pa.RecordBatch], pa.RecordBatch]]
+                   ops: Sequence[Callable[[pa.RecordBatch], pa.RecordBatch]],
+                   cancelled: Optional[threading.Event] = None
                    ) -> pa.RecordBatch:
-    attempts = EngineConfig.max_task_retries + 1
-    last_err: Optional[BaseException] = None
-    for attempt in range(attempts):
-        try:
-            if EngineConfig.fault_injector is not None:
-                EngineConfig.fault_injector(index, attempt)
-            out = batch
-            for op in ops:
-                out = op(out)
-            return out
-        except Exception as e:  # noqa: BLE001 - task boundary
-            last_err = e
-    raise TaskFailure(
-        f"partition {index} failed after {attempts} attempts: {last_err}"
-    ) from last_err
+    """One partition task: classified retry per engine/supervisor.py
+    (FATAL never retried, OOM surfaced, RETRYABLE backed off; terminal
+    TaskFailure carries the per-attempt history). ``cancelled`` is the
+    supervisor watchdog's abandonment signal (None on inline paths)."""
+    return _sup.run_partition_task(
+        index, batch, ops, policy=_task_policy(),
+        deadline_s=EngineConfig.task_timeout_s,
+        legacy_injector=EngineConfig.fault_injector,
+        max_fatal_attempts=(EngineConfig.quarantine_max_fatal
+                            if EngineConfig.quarantine else 1),
+        cancelled=cancelled)
 
 
 def _as_record_batches(table: pa.Table, num_partitions: int) -> List[pa.RecordBatch]:
@@ -174,6 +227,17 @@ class DataFrame:
 
     # -- execution -----------------------------------------------------------
 
+    def _quarantine_probe(self, index: int) -> pa.RecordBatch:
+        """Zero-row stand-in for a quarantined partition: the op chain run
+        on an empty slice keeps the chain's output schema and partition
+        alignment while dropping the poisoned rows (data-dependent
+        failures don't fire on zero rows; if even this fails, the
+        supervisor propagates the original TaskFailure)."""
+        out = self._partitions[index].slice(0, 0)
+        for op in self._ops:
+            out = op(out)
+        return out
+
     def _materialize(self) -> List[pa.RecordBatch]:
         with self._lock:
             if self._materialized is not None:
@@ -181,26 +245,30 @@ class DataFrame:
             if not self._ops:
                 self._materialized = self._partitions
                 return self._materialized
-            if len(self._partitions) == 1:
-                self._materialized = [_run_partition(0, self._partitions[0], self._ops)]
-                return self._materialized
             if threading.current_thread().name.startswith("sparkdl-part"):
                 # nested materialization from inside a partition task: run
                 # inline — waiting on the shared pool from one of its own
-                # threads could deadlock
+                # threads could deadlock. Classified retry still applies;
+                # deadline enforcement is cooperative only (no watchdog).
                 self._materialized = [
                     _run_partition(i, b, self._ops)
                     for i, b in enumerate(self._partitions)]
                 return self._materialized
-            pool = _executor()
-            futs = [pool.submit(_run_partition, i, b, self._ops)
-                    for i, b in enumerate(self._partitions)]
-            # Wait for ALL tasks before raising any failure: the shared
-            # pool outlives this call, so sibling tasks must not still be
-            # running user ops when the caller starts failure cleanup (the
-            # old per-call executor's shutdown gave this barrier for free).
-            _futures.wait(futs)
-            self._materialized = [f.result() for f in futs]
+            # Supervised parallel execution (engine/supervisor.py):
+            # classified retry per task, deadline watchdog, optional
+            # straggler hedging and quarantine. The supervisor keeps the
+            # old barrier semantics on FAILURE — it waits out attempts
+            # still running user ops (the shared pool outlives this call),
+            # skipping only watchdog-failed tasks, whose threads may be
+            # wedged on the hung op. A clean run may leave a hedge
+            # loser's discarded pure ops finishing in the background.
+            sup = PartitionSupervisor(_executor(), _supervisor_config(),
+                                      quarantine_probe=self._quarantine_probe)
+            ops = self._ops
+            self._materialized = sup.run_all(
+                [(i, lambda cancel, i=i, b=b: _run_partition(i, b, ops,
+                                                             cancel))
+                 for i, b in enumerate(self._partitions)])
             return self._materialized
 
     def toArrow(self) -> pa.Table:
@@ -288,27 +356,24 @@ class DataFrame:
             for i in indices:
                 yield _run_partition(i, self._partitions[i], self._ops)
             return
-        import collections as _collections
+        # Supervised bounded-prefetch streaming on the shared process-wide
+        # executor (VERDICT r3 weak #6: no per-epoch pool churn). In-flight
+        # work is capped by `prefetch`, not by pool width; tasks get the
+        # same classified retry / deadline watchdog / hedging / quarantine
+        # as _materialize. Abandoned iteration (early break / error)
+        # CANCELS unstarted attempts before draining the running ones, so
+        # an early break doesn't silently compute (and decode) the rest of
+        # the epoch.
+        sup = PartitionSupervisor(_executor(), _supervisor_config(),
+                                  quarantine_probe=self._quarantine_probe)
+        parts, ops = self._partitions, self._ops
 
-        # Bounded-prefetch streaming on the shared process-wide executor
-        # (VERDICT r3 weak #6: no per-epoch pool churn). In-flight work is
-        # capped by `prefetch`, not by pool width.
-        pending: "_collections.deque" = _collections.deque()
-        pool = _executor()
-        try:
+        def runners():
             for i in indices:
-                pending.append(pool.submit(_run_partition, i,
-                                           self._partitions[i], self._ops))
-                while len(pending) > prefetch:
-                    yield pending.popleft().result()
-            while pending:
-                yield pending.popleft().result()
-        finally:
-            # Abandoned iteration (early break / error): drain remaining
-            # futures so user ops aren't still running on the shared pool
-            # while the caller unwinds (same barrier _materialize keeps).
-            if pending:
-                _futures.wait(list(pending))
+                yield i, (lambda cancel, i=i: _run_partition(
+                    i, parts[i], ops, cancel))
+
+        yield from sup.run_stream(runners(), prefetch=prefetch)
 
     # -- transformations (lazy) ----------------------------------------------
 

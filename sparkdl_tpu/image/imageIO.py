@@ -25,9 +25,19 @@ from typing import Callable, Iterable, List, Optional, Sequence, Tuple
 import numpy as np
 import pyarrow as pa
 
-from sparkdl_tpu.core import resilience
+from sparkdl_tpu.core import health, resilience
 
 logger = logging.getLogger(__name__)
+
+
+def _injected_decode_error(**ctx) -> bool:
+    """The decode_error behavioral point, recorded in the health monitor
+    (one DECODE_DEGRADED per degraded row, so a run's report shows how
+    many rows the data plane dropped to null)."""
+    if resilience.should_fire("decode_error", **ctx):
+        health.record(health.DECODE_DEGRADED, injected=True)
+        return True
+    return False
 
 # ---------------------------------------------------------------------------
 # Schema: field-for-field the Spark ImageSchema struct the reference used.
@@ -183,6 +193,8 @@ def _stage_structs(structs, target_size, dtype, channels, tolerant: bool
                 raise
             dropped += 1
             logger.debug("dropping undecodable image row %d: %s", i, e)
+    if dropped:
+        health.record(health.DECODE_DEGRADED, n=dropped, stage="structs")
     if arrays:
         if dtype is None and len({a.dtype for a in arrays}) > 1:
             arrays = [np.asarray(a, dtype="float32") for a in arrays]
@@ -294,7 +306,7 @@ def decodeImageBytes(data: bytes, target_size=None,
             # decode and mistarget occurrence-indexed Faults
             return decodeImageBytesBatch([data], target_size,
                                          channels=channels)[0]
-        if resilience.should_fire("decode_error"):
+        if _injected_decode_error():
             return None
         # no target size: native decode (fast path, GIL released)
         # preserves channels; coerce after
@@ -302,14 +314,20 @@ def decodeImageBytes(data: bytes, target_size=None,
             arr = native_loader.decode(data, target_size=None)
             if arr is not None:
                 return forceChannels(arr, channels)
-        return _pil_decode_channels(data, None, channels)
-    if resilience.should_fire("decode_error"):
+        out = _pil_decode_channels(data, None, channels)
+        if out is None:
+            health.record(health.DECODE_DEGRADED, stage="bytes")
+        return out
+    if _injected_decode_error():
         return None
     if native_loader.available():
         arr = native_loader.decode(data, target_size=target_size)
         if arr is not None:
             return arr
-    return _pil_decode(data, target_size=target_size)
+    out = _pil_decode(data, target_size=target_size)
+    if out is None:
+        health.record(health.DECODE_DEGRADED, stage="bytes")
+    return out
 
 
 def stripFileScheme(uri: str) -> str:
@@ -349,7 +367,7 @@ def decodeImageBytesBatch(blobs: Sequence[Optional[bytes]],
 
     out: List[Optional[np.ndarray]] = [None] * len(blobs)
     valid = [i for i, b in enumerate(blobs)
-             if b and not resilience.should_fire("decode_error")]
+             if b and not _injected_decode_error()]
     if not valid:
         return out
     res = native_loader.decode_batch_status(
@@ -362,6 +380,10 @@ def decodeImageBytesBatch(blobs: Sequence[Optional[bytes]],
     remaining = [i for i in valid if out[i] is None]
     for i in remaining:
         out[i] = _pil_decode_channels(blobs[i], target_size, channels)
+    undecodable = sum(1 for i in valid if out[i] is None)
+    if undecodable:
+        # genuinely corrupt blobs (injected fires were counted above)
+        health.record(health.DECODE_DEGRADED, n=undecodable, stage="bytes")
     return out
 
 

@@ -25,7 +25,7 @@ from typing import Any, Callable, Optional
 
 import jax
 
-from sparkdl_tpu.core import resilience
+from sparkdl_tpu.core import health, resilience
 from sparkdl_tpu.core.mesh import MeshConfig, make_mesh
 
 logger = logging.getLogger(__name__)
@@ -52,11 +52,13 @@ class TPURunner:
     """Run a training function over an ``np``-device data-parallel mesh.
 
     Restart semantics (core.resilience): a failed ``main`` is classified —
-    FATAL errors (shape/dtype/``ValueError``: deterministic, a restart
-    replays them) raise immediately with zero restart attempts; everything
-    else (preemption, transient runtime errors — the gang-failure class)
-    restarts up to ``max_restarts`` times with exponential backoff and
-    deterministic jitter instead of a fixed delay. Train fns that
+    only RETRYABLE errors (preemption, transient runtime errors — the
+    gang-failure class) restart, up to ``max_restarts`` times with
+    exponential backoff and deterministic jitter instead of a fixed
+    delay. FATAL errors (shape/dtype/``ValueError``: deterministic, a
+    restart replays them) and OOM (a same-shape replay reproduces it;
+    the batch-shrink response lives in core.batching, not here) raise
+    immediately with zero restart attempts. Train fns that
     checkpoint via ``Trainer.fit(checkpoint=...)`` resume from
     ``CheckpointManager.latest_step()``, not step 0.
 
@@ -110,23 +112,34 @@ class TPURunner:
             try:
                 return main(**call_kwargs)
             except Exception as e:  # noqa: BLE001 - gang boundary
-                if resilience.classify(e) == resilience.FATAL:
-                    # Deterministic failure: a restart replays it from the
-                    # checkpoint and fails again — surface it unretried.
+                kind = resilience.classify(e)
+                if kind != resilience.RETRYABLE:
+                    # FATAL: deterministic — a restart replays it from the
+                    # checkpoint and fails again. OOM: a same-shape replay
+                    # reproduces it too, and the runner has no batch-shrink
+                    # response (that lives in core.batching) — surface
+                    # both unretried.
+                    health.record(health.GANG_FATAL, kind=kind,
+                                  error=type(e).__name__)
                     logger.error(
-                        "TPURunner: attempt %d failed with a fatal error "
-                        "(%s: %s); not restarting", attempt + 1,
+                        "TPURunner: attempt %d failed with a %s error "
+                        "(%s: %s); not restarting", attempt + 1, kind,
                         type(e).__name__, e)
                     raise
                 last_err = e
                 if attempt + 1 < attempts:
                     delay = self.retry_policy.delay(attempt + 1)
+                    health.record(health.GANG_RESTART, attempt=attempt + 1,
+                                  error=type(e).__name__)
                     logger.warning(
                         "TPURunner: attempt %d/%d failed (%s: %s); "
                         "restarting in %.2fs", attempt + 1, attempts,
                         type(e).__name__, e, delay)
                     if delay > 0:
                         time.sleep(delay)
+        health.record(health.GANG_FAILED, attempts=attempts,
+                      error=type(last_err).__name__
+                      if last_err is not None else None)
         raise RuntimeError(
             f"TPURunner: train fn failed after {attempts} attempts"
         ) from last_err

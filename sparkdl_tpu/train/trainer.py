@@ -25,7 +25,7 @@ import numpy as np
 import optax
 from flax import struct
 
-from sparkdl_tpu.core import profiling, resilience
+from sparkdl_tpu.core import health, profiling, resilience
 from sparkdl_tpu.core.mesh import batch_sharding, replicated
 from sparkdl_tpu.train.checkpoint import CheckpointManager
 from sparkdl_tpu.train.metrics import MetricsLogger
@@ -404,6 +404,7 @@ class Trainer:
             if latest is not None:
                 state = checkpoint.restore(state)
                 state = jax.tree.map(jnp.asarray, state)
+                health.record(health.FIT_RESUMED, step=int(state.step))
         train_step = self.make_train_step()
         multihost = self.mesh is not None and jax.process_count() > 1
         if self.mesh is not None:
@@ -480,6 +481,7 @@ class Trainer:
         if checkpoint is not None:
             checkpoint.save(int(state.step), jax.device_get(state),
                             synchronous=True)
+        health.record(health.FIT_COMPLETED, steps=int(state.step))
         return state
 
     def variables_of(self, state: TrainState) -> Dict[str, Any]:

@@ -1,0 +1,94 @@
+"""HealthMonitor: counters, bounded events, process-wide scoping, report."""
+
+import logging
+import threading
+
+import pytest
+
+from sparkdl_tpu.core import health
+from sparkdl_tpu.core.health import HealthMonitor
+
+
+def test_record_counts_and_events():
+    mon = HealthMonitor("t")
+    mon.record("task_retried", partition=3, kind="retryable")
+    mon.record("task_retried", partition=5, kind="retryable")
+    mon.record("oom_rechunk", bucket=16, half=8)
+    assert mon.count("task_retried") == 2
+    assert mon.count("oom_rechunk") == 1
+    assert mon.count("nothing") == 0
+    assert mon.counters() == {"task_retried": 2, "oom_rechunk": 1}
+    evs = mon.events("task_retried")
+    assert [e["partition"] for e in evs] == [3, 5]
+    assert len(mon.events()) == 3
+
+
+def test_record_n_batches_counter():
+    mon = HealthMonitor()
+    mon.record("decode_degraded", n=4, stage="structs")
+    assert mon.count("decode_degraded") == 4
+    assert mon.events("decode_degraded")[0]["n"] == 4
+
+
+def test_event_log_bounded_counter_unbounded():
+    mon = HealthMonitor(max_events=3)
+    for i in range(10):
+        mon.record("e", i=i)
+    assert mon.count("e") == 10
+    assert len(mon.events()) == 3
+    rep = mon.report()
+    assert rep["events_recorded"] == 3 and rep["events_dropped"] == 7
+
+
+def test_module_record_requires_active_monitor():
+    health.record("task_started")  # no monitor: no-op, no error
+    assert health.active_monitor() is None
+    with HealthMonitor("outer") as outer:
+        health.record("task_started")
+        with HealthMonitor("inner") as inner:
+            health.record("task_started")
+            assert health.active_monitor() is inner
+        health.record("task_started")
+        assert health.active_monitor() is outer
+    assert health.active_monitor() is None
+    assert outer.count("task_started") == 2
+    assert inner.count("task_started") == 1
+
+
+def test_record_visible_from_worker_threads():
+    """Process-wide by design (the FaultInjector rationale): engine
+    partition tasks record from pool threads."""
+    with HealthMonitor() as mon:
+        threads = [threading.Thread(
+            target=lambda: [health.record("tick") for _ in range(100)])
+            for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert mon.count("tick") == 400
+
+
+def test_report_and_quarantine_registry():
+    mon = HealthMonitor("chaos-run")
+    mon.record(health.TASK_QUARANTINED, partition=2, error="boom")
+    mon.record(health.TASK_RETRIED, partition=0)
+    rep = mon.report()
+    assert rep["run"] == "chaos-run"
+    assert rep["counters"] == {"task_quarantined": 1, "task_retried": 1}
+    assert rep["quarantined"] == [
+        {"event": "task_quarantined", "partition": 2, "error": "boom"}]
+    assert mon.quarantined()[0]["partition"] == 2
+
+
+def test_log_report_once_at_job_end(caplog):
+    with caplog.at_level(logging.INFO, logger="sparkdl_tpu.core.health"):
+        with HealthMonitor("r1"):
+            health.record("gang_restart")
+        # deactivation IS the job-end hook: one report, cumulative
+        health.log_report()  # inactive: no-op
+        with HealthMonitor("empty"):
+            pass  # nothing recorded: no report noise
+    msgs = [r.message for r in caplog.records]
+    assert any("'r1'" in m and "gang_restart=1" in m for m in msgs)
+    assert len([m for m in msgs if "health report" in m]) == 1

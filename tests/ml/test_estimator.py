@@ -210,11 +210,11 @@ def test_streaming_fit_many_partitions_bounded(labeled_image_df, monkeypatch):
     in_flight = {"now": 0, "peak": 0}
     real = edf._run_partition
 
-    def tracked(index, batch, ops):
+    def tracked(index, batch, ops, cancelled=None):
         in_flight["now"] += 1
         in_flight["peak"] = max(in_flight["peak"], in_flight["now"])
         try:
-            return real(index, batch, ops)
+            return real(index, batch, ops, cancelled)
         finally:
             in_flight["now"] -= 1
 

@@ -1,0 +1,238 @@
+"""Chaos suite: composed fault injection across a full files→decode→
+transform→fit pipeline (ISSUE 2 acceptance; docs/RESILIENCE.md).
+
+One seeded FaultInjector fires `decode_error` → `engine_task` (worker
+loss after compute) → `device_oom` → `transfer_stall` → `preemption` in a
+single run; the pipeline must complete, produce results bit-identical to
+the fault-free run, and the HealthMonitor report must match the injected
+fault counts exactly.
+"""
+
+import re
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import jax
+import flax.linen as nn
+
+from sparkdl_tpu.core import health, resilience
+from sparkdl_tpu.core.health import HealthMonitor
+from sparkdl_tpu.core.model_function import ModelFunction, TensorSpec
+from sparkdl_tpu.core.resilience import Fault, FaultInjector
+from sparkdl_tpu.engine import DataFrame, EngineConfig, TaskFailure
+from sparkdl_tpu.image import imageIO
+from sparkdl_tpu.ml.image_transformer import TPUImageTransformer
+from sparkdl_tpu.train import CheckpointManager, TPURunner, Trainer
+
+pytestmark = pytest.mark.chaos
+
+_N_IMAGES = 12
+_FEATURES = 4
+
+
+@pytest.fixture(autouse=True)
+def _restore_engine_config():
+    saved = {k: getattr(EngineConfig, k) for k in (
+        "task_timeout_s", "speculation", "speculation_quantile",
+        "speculation_min_runtime_s", "quarantine", "quarantine_max_fatal",
+        "max_task_retries", "max_workers")}
+    yield
+    for k, v in saved.items():
+        setattr(EngineConfig, k, v)
+
+
+@pytest.fixture
+def image_dir(tmp_path):
+    from PIL import Image
+
+    rng = np.random.default_rng(7)
+    d = tmp_path / "imgs"
+    d.mkdir()
+    for i in range(_N_IMAGES):
+        arr = rng.integers(0, 255, size=(8, 8, 3), dtype=np.uint8)
+        Image.fromarray(arr).save(d / f"img_{i:02d}.png")
+    return d
+
+
+def _feature_model() -> ModelFunction:
+    rng = np.random.default_rng(0)
+    import jax.numpy as jnp
+
+    w = jnp.asarray(rng.normal(size=(8 * 8 * 3, _FEATURES))
+                    .astype(np.float32) * 0.01)
+    return ModelFunction(
+        lambda vs, x: jnp.tanh(x.reshape((x.shape[0], -1)) @ vs),
+        w, TensorSpec((None, 8, 8, 3), "float32"), name="chaos_feat")
+
+
+class _MLP(nn.Module):
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return jax.nn.softmax(nn.Dense(2)(nn.relu(nn.Dense(8)(x))), axis=-1)
+
+
+_MODULE = _MLP()
+_VARIABLES = _MODULE.init(jax.random.PRNGKey(0),
+                          np.zeros((1, _FEATURES), np.float32))
+
+
+def _run_pipeline(image_dir, ckpt_dir):
+    """files → decode (1 task) → transform (3 partitions) → fit (TPURunner
+    gang, per-step checkpoints). Returns (features, labels, final_state,
+    executed-step trace)."""
+    # decode stage: one partition task so the composed decode_error +
+    # engine_task(finish) faults deterministically hit the same attempt
+    df = imageIO.readImages(str(image_dir), numPartition=1)
+    df = df.withColumn(
+        "label", lambda p: int(re.search(r"img_(\d+)", p).group(1)) % 2,
+        ["filePath"], pa.int64())
+    df = df.repartition(3)  # materializes the decode; transform fans out
+    t = TPUImageTransformer(inputCol="image", outputCol="features",
+                            modelFunction=_feature_model(), batchSize=8,
+                            outputMode="vector")
+    rows = t.transform(df).select("features", "label").collect()
+    assert all(r["features"] is not None for r in rows)
+    x = np.asarray([r["features"] for r in rows], dtype=np.float32)
+    y = np.eye(2, dtype=np.float32)[[r["label"] for r in rows]]
+    batches = [(x[i:i + 4], y[i:i + 4]) for i in range(0, _N_IMAGES, 4)]
+    steps_run = []
+
+    def train_fn(mesh=None):
+        trainer, state = Trainer.from_flax(_MODULE, _VARIABLES,
+                                           optimizer="sgd",
+                                           learning_rate=0.1, mesh=mesh)
+        ckpt = CheckpointManager(str(ckpt_dir))
+        state = trainer.fit(state, batches, epochs=2, checkpoint=ckpt,
+                            checkpoint_every=1, on_step=steps_run.append)
+        ckpt.wait_until_finished()
+        ckpt.close()
+        return jax.device_get(state)
+
+    final = TPURunner(np=2, max_restarts=2).run(train_fn)
+    return x, y, final, steps_run
+
+
+def test_chaos_pipeline_recovers_bit_identical(image_dir, tmp_path):
+    """Acceptance: all five fault points fire in ONE run; the pipeline
+    completes; features are bit-identical and trained params match the
+    fault-free run; the health report equals the injected counts."""
+    x0, y0, final0, steps0 = _run_pipeline(image_dir, tmp_path / "plain")
+
+    inj = FaultInjector.seeded(
+        0,
+        # row 0's decode degrades to a null struct on the decode task's
+        # first attempt...
+        decode_error=1,
+        # ...and the same attempt's worker dies after computing but before
+        # delivering its result — the classified task retry re-decodes
+        # everything cleanly (recovery makes decode_error bit-recoverable)
+        engine_task=Fault(times=1, when=lambda c: (
+            c.get("phase") == "finish" and c["attempt"] == 0)),
+        # first full transform chunk OOMs → bucket-halving re-chunk
+        device_oom=Fault(times=1, when=lambda c: c["rows"] >= 8),
+        # one transient transfer failure → same-chunk retry
+        transfer_stall=1,
+        # gang preemption after step 3's checkpoint → restart + resume
+        preemption=Fault(when=lambda c: c["step"] == 3),
+    )
+    with inj, HealthMonitor("chaos") as mon:
+        x1, y1, final1, steps1 = _run_pipeline(image_dir, tmp_path / "chaos")
+
+    # every armed point actually fired, exactly once
+    assert inj.fired == {"decode_error": 1, "engine_task": 1,
+                         "device_oom": 1, "transfer_stall": 1,
+                         "preemption": 1}
+
+    # bit-identical data-plane results vs the fault-free run
+    np.testing.assert_array_equal(x1, x0)
+    np.testing.assert_array_equal(y1, y0)
+    # checkpoint-resumed training matches: every step executed once, and
+    # final params agree with the uninterrupted run
+    assert steps1 == steps0 == [1, 2, 3, 4, 5, 6]
+    for a, b in zip(jax.tree.leaves(final0.params),
+                    jax.tree.leaves(final1.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+
+    # the health report matches the injected fault counts exactly
+    assert mon.count(health.DECODE_DEGRADED) == inj.fired["decode_error"]
+    assert mon.count(health.TASK_RETRIED) == inj.fired["engine_task"]
+    assert mon.count(health.OOM_RECHUNK) == inj.fired["device_oom"]
+    assert mon.count(health.CHUNK_RETRY) == inj.fired["transfer_stall"]
+    assert mon.count(health.GANG_RESTART) == inj.fired["preemption"]
+    assert mon.count(health.FIT_RESUMED) == 1
+    assert mon.count(health.FIT_COMPLETED) == 1
+    assert mon.count(health.TASK_QUARANTINED) == 0
+    assert mon.count(health.TASK_DEADLINE_EXCEEDED) == 0
+    assert mon.count(health.GANG_FATAL) == 0
+
+
+def test_chaos_fatal_transform_error_retried_zero_times(image_dir):
+    """Acceptance: FATAL errors are provably retried zero times, end to
+    end — the engine task fails once, and the gang boundary (classify on
+    TaskFailure.failure_kind) would not restart it either."""
+    df = imageIO.readImages(str(image_dir), numPartition=2)
+    calls = []
+
+    def bad(batch):
+        calls.append(1)
+        raise ValueError("deliberate contract violation")
+
+    with pytest.raises(TaskFailure) as ei:
+        df.mapPartitions(bad).collect()
+    assert len(calls) == 2  # one attempt per partition, zero retries
+    assert ei.value.retries() == 0
+    assert resilience.classify(ei.value) == resilience.FATAL
+
+
+def test_chaos_stalled_partition_fails_via_deadline(image_dir):
+    """Acceptance: a deliberately stalled decode partition fails via
+    Deadline instead of wedging the materialization."""
+    EngineConfig.task_timeout_s = 0.4
+    df = imageIO.readImages(str(image_dir), numPartition=3)
+    t0 = time.monotonic()
+    with FaultInjector.seeded(0, task_stall=Fault(
+            when=lambda c: c["partition"] == 2)) as inj:
+        with HealthMonitor() as mon:
+            with pytest.raises(TaskFailure, match="deadline"):
+                df.collect()
+    assert inj.fired["task_stall"] == 1
+    assert time.monotonic() - t0 < 5.0
+    assert mon.count(health.TASK_DEADLINE_EXCEEDED) == 1
+
+
+def test_chaos_straggler_hedged_and_deduplicated(image_dir):
+    """Acceptance: a straggler decode partition is hedged; the duplicate's
+    result is deduplicated deterministically (output equals the
+    unhedged run's, each row exactly once)."""
+    EngineConfig.speculation = True
+    EngineConfig.speculation_quantile = 0.5
+    EngineConfig.speculation_min_runtime_s = 0.05
+    # fresh, wide pool so the hedge isn't queued behind the straggler
+    EngineConfig.max_workers = 9
+    df = imageIO.readImages(str(image_dir), numPartition=6)
+    baseline = df.collect()
+    stalled = set()
+    import threading
+
+    lock = threading.Lock()
+
+    def slow_once(batch):
+        key = batch.column(0)[0].as_py()
+        with lock:
+            again = key in stalled
+            stalled.add(key)
+        if key.endswith("img_10.png") and not again:
+            time.sleep(2.0)  # environmental slowness on the primary only
+        return batch
+
+    t0 = time.monotonic()
+    with HealthMonitor() as mon:
+        rows = df.mapPartitions(slow_once).collect()
+    assert rows == baseline  # identical, order-preserving, no duplicates
+    assert mon.count(health.TASK_HEDGED) == 1
+    assert mon.count(health.HEDGE_WON) == 1
+    assert time.monotonic() - t0 < 1.5

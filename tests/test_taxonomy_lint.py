@@ -1,0 +1,106 @@
+"""Static check: no bare `except Exception: retry` loops bypassing
+core.resilience.classify (ISSUE 2 satellite; keeps the error taxonomy the
+single source of truth).
+
+The rule: inside a `for`/`while` loop, a broad handler (`except:`,
+`except Exception`, `except BaseException`) must either re-raise
+somewhere in its body or consult the taxonomy (reference `classify` or
+the `resilience` module). A handler that swallows broadly and lets the
+loop re-attempt is exactly the blind-retry shape PR 1/2 removed — FATAL
+user errors would be silently replayed.
+
+Deliberate broad swallows that are NOT retries (per-row degradation that
+re-raises conditionally already passes; anything else) can opt out with a
+`# taxonomy-ok: <reason>` comment on the `except` line.
+"""
+
+import ast
+import pathlib
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent / "sparkdl_tpu"
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    if isinstance(t, ast.Name):
+        return t.id in _BROAD
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(e, ast.Name) and e.id in _BROAD
+                   for e in t.elts)
+    return False
+
+
+def _consults_taxonomy_or_raises(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Name) and node.id in ("classify",
+                                                      "resilience"):
+            return True
+        if isinstance(node, ast.Attribute) and node.attr == "classify":
+            return True
+    return False
+
+
+class _LoopHandlerVisitor(ast.NodeVisitor):
+    def __init__(self, source_lines):
+        self.loop_depth = 0
+        self.lines = source_lines
+        self.violations = []
+
+    def _loop(self, node):
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    visit_For = visit_While = visit_AsyncFor = _loop
+
+    def visit_Try(self, node):
+        for handler in node.handlers:
+            if (self.loop_depth > 0 and _is_broad(handler)
+                    and not _consults_taxonomy_or_raises(handler)
+                    and "taxonomy-ok" not in
+                    self.lines[handler.lineno - 1]):
+                self.violations.append(handler.lineno)
+        self.generic_visit(node)
+
+    # TryStar (3.11 except*) gets the same treatment if it ever appears
+    visit_TryStar = visit_Try
+
+
+def test_no_blind_broad_retry_loops():
+    offenders = []
+    for path in sorted(ROOT.rglob("*.py")):
+        source = path.read_text()
+        tree = ast.parse(source, filename=str(path))
+        visitor = _LoopHandlerVisitor(source.splitlines())
+        visitor.visit(tree)
+        offenders.extend(f"{path.relative_to(ROOT.parent)}:{line}"
+                         for line in visitor.violations)
+    assert not offenders, (
+        "broad except inside a loop without re-raise or "
+        "core.resilience.classify — blind retry would replay FATAL "
+        "errors. Route the handler through resilience.classify (or mark "
+        "a deliberate non-retry swallow with '# taxonomy-ok: <reason>'): "
+        f"{offenders}")
+
+
+def test_lint_catches_the_old_blind_retry_shape():
+    """Self-test: the pre-supervision `_run_partition` loop (retry every
+    failure blindly) must trip the lint."""
+    bad = (
+        "def run(ops, batch):\n"
+        "    for attempt in range(3):\n"
+        "        try:\n"
+        "            return ops(batch)\n"
+        "        except Exception as e:\n"
+        "            last = e\n"
+    )
+    tree = ast.parse(bad)
+    v = _LoopHandlerVisitor(bad.splitlines())
+    v.visit(tree)
+    assert v.violations == [5]

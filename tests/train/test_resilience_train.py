@@ -108,6 +108,9 @@ def test_fatal_error_raises_without_restart():
 
 
 def test_runner_backoff_uses_policy_delays(monkeypatch):
+    from sparkdl_tpu.core import health
+    from sparkdl_tpu.core.health import HealthMonitor
+
     slept = []
     monkeypatch.setattr("sparkdl_tpu.train.runner.time.sleep", slept.append)
     policy = RetryPolicy(max_retries=2, base_delay_s=1.0, jitter=0.0)
@@ -115,9 +118,35 @@ def test_runner_backoff_uses_policy_delays(monkeypatch):
     def always_fail(mesh=None):
         raise RuntimeError("worker lost")
 
-    with pytest.raises(RuntimeError, match="after 3 attempts"):
-        TPURunner(np=2, max_restarts=2, retry_policy=policy).run(always_fail)
+    with HealthMonitor() as mon:
+        with pytest.raises(RuntimeError, match="after 3 attempts"):
+            TPURunner(np=2, max_restarts=2,
+                      retry_policy=policy).run(always_fail)
     assert slept == [1.0, 2.0]  # exponential, not fixed
+    # the health report distinguishes restarted-and-died from recovered
+    assert mon.count(health.GANG_RESTART) == 2
+    assert mon.count(health.GANG_FAILED) == 1
+
+
+def test_runner_oom_gang_failure_not_restarted():
+    """A same-shape replay reproduces an OOM and the runner has no
+    batch-shrink response — surface it unretried, like FATAL."""
+    from sparkdl_tpu.core import health
+    from sparkdl_tpu.core.health import HealthMonitor
+    from sparkdl_tpu.core.resilience import DeviceOOM
+
+    attempts = []
+
+    def oom_fn(mesh=None):
+        attempts.append(1)
+        raise DeviceOOM()
+
+    with HealthMonitor() as mon:
+        with pytest.raises(DeviceOOM):
+            TPURunner(np=2, max_restarts=3).run(oom_fn)
+    assert len(attempts) == 1
+    assert mon.count(health.GANG_FATAL) == 1
+    assert mon.count(health.GANG_RESTART) == 0
 
 
 # -- checkpoint corruption ---------------------------------------------------
