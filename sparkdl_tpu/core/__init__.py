@@ -12,7 +12,9 @@ from sparkdl_tpu.core.mesh import (
 from sparkdl_tpu.core.model_function import ModelFunction, InputModel, TensorSpec
 from sparkdl_tpu.core import batching
 from sparkdl_tpu.core import health
+from sparkdl_tpu.core import pipeline
 from sparkdl_tpu.core import resilience
+from sparkdl_tpu.core.pipeline import DevicePrefetcher
 from sparkdl_tpu.core.health import HealthMonitor
 from sparkdl_tpu.core.resilience import (
     Deadline, Fault, FaultInjector, RetryPolicy, classify,
@@ -23,7 +25,7 @@ __all__ = [
     "MeshConfig", "make_mesh", "data_parallel_mesh", "batch_sharding",
     "replicated", "shard_batch",
     "ModelFunction", "InputModel", "TensorSpec",
-    "batching", "health", "resilience",
-    "Deadline", "Fault", "FaultInjector", "HealthMonitor", "RetryPolicy",
-    "classify",
+    "batching", "health", "pipeline", "resilience",
+    "Deadline", "DevicePrefetcher", "Fault", "FaultInjector",
+    "HealthMonitor", "RetryPolicy", "classify",
 ]

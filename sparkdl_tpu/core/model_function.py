@@ -387,7 +387,8 @@ class ModelFunction:
         return fn
 
     def apply_batch(self, array, batch_size: int = 64,
-                    mesh=None, retry_policy=None) -> np.ndarray:
+                    mesh=None, retry_policy=None,
+                    prefetch: int = 2) -> np.ndarray:
         """Run over N rows with fixed-shape padded chunks; returns numpy.
 
         ``array``: one ndarray, or — for multi-input models whose
@@ -404,6 +405,10 @@ class ModelFunction:
         device→host fetch (async dispatch) re-run the whole call at a
         halved ``batch_size`` — inputs are host-resident, so the re-run is
         idempotent.
+
+        ``prefetch``: chunk-staging depth of the async input pipeline
+        (core.pipeline; 0 = inline staging) — the featurize/transform
+        analog of the Trainer's prefetcher (ISSUE 3).
         """
         from sparkdl_tpu.core import resilience
 
@@ -429,7 +434,8 @@ class ModelFunction:
             try:
                 return batching.run_batched(fn, array, batch_size,
                                             multiple=multiple,
-                                            retry_policy=retry_policy)
+                                            retry_policy=retry_policy,
+                                            prefetch=prefetch)
             except Exception as e:  # noqa: BLE001 - classified below
                 half = batch_size // 2
                 if (resilience.classify(e) != resilience.OOM

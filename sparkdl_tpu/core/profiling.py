@@ -38,6 +38,26 @@ _phase_counts: Dict[str, int] = {}
 
 PROFILE_DIR_ENV = "SPARKDL_PROFILE_DIR"
 
+# Canonical phase names for the async input pipeline (core/pipeline.py).
+# HOST_WAIT is the starvation timer: seconds the device-driving thread
+# spent waiting for the staging thread to deliver a batch. With the
+# pipeline overlapped, host ETL phases (sparkdl.decode / sparkdl.stage /
+# sparkdl.stage_batch) accumulate on the STAGING thread concurrently with
+# sparkdl.train_step on the main thread — phase totals can legitimately
+# sum past wall-clock; HOST_WAIT is the serial remainder the host still
+# costs the device. DEVICE_SYNC times the deferred step-counter barriers
+# (Trainer.fit sync points), i.e. real device execution the host waited
+# out, where the pre-pipeline sparkdl.train_step span folded dispatch and
+# execution together.
+HOST_WAIT = "sparkdl.host_wait"
+STAGE_BATCH = "sparkdl.stage_batch"
+DEVICE_SYNC = "sparkdl.device_sync"
+
+# Host ETL phases whose time the pipeline can hide behind device compute
+# (used by overlap accounting: bench.py's overlap_ratio).
+HOST_ETL_PHASES = ("sparkdl.decode", "sparkdl.stage", STAGE_BATCH,
+                   "sparkdl.host_stage", "sparkdl.host_resize")
+
 
 @contextlib.contextmanager
 def annotate(name: str) -> Iterator[None]:
@@ -51,6 +71,31 @@ def annotate(name: str) -> Iterator[None]:
     with _lock:
         _phase_totals[name] = _phase_totals.get(name, 0.0) + dt
         _phase_counts[name] = _phase_counts.get(name, 0) + 1
+
+
+def add_phase_time(name: str, seconds: float, count: int = 1) -> None:
+    """Feed a phase timer directly (no span) — for waits measured by the
+    async pipeline where a TraceAnnotation per queue-get would be noise."""
+    with _lock:
+        _phase_totals[name] = _phase_totals.get(name, 0.0) + seconds
+        _phase_counts[name] = _phase_counts.get(name, 0) + count
+
+
+def overlap_stats() -> Dict[str, float]:
+    """Overlap accounting for the async input pipeline.
+
+    ``host_etl_s``: host decode/stage seconds (the work the pipeline can
+    hide). ``host_wait_s``: seconds the device-driving thread actually
+    waited on the host (starvation). ``overlap_ratio``: fraction of host
+    ETL hidden behind device compute — 1.0 means the host was never the
+    bottleneck, 0.0 means fully serial (every ETL second stalled the
+    device, the pre-pipeline behavior).
+    """
+    stats = phase_stats()
+    etl = sum(stats[p]["total_s"] for p in HOST_ETL_PHASES if p in stats)
+    wait = stats.get(HOST_WAIT, {}).get("total_s", 0.0)
+    ratio = 1.0 if etl <= 0 else max(0.0, min(1.0, 1.0 - wait / etl))
+    return {"host_etl_s": etl, "host_wait_s": wait, "overlap_ratio": ratio}
 
 
 def phase_stats(reset: bool = False) -> Dict[str, Dict[str, float]]:

@@ -60,7 +60,10 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         "'validation_split': float tail fraction held out (collected "
         "path only), 'verbose': bool (per-step metrics JSONL to stdout), "
         "'log_every': int, 'checkpoint_dir': str (Orbax mid-training "
-        "checkpoints + resume), 'checkpoint_every': int steps}",
+        "checkpoints + resume), 'checkpoint_every': int steps, "
+        "'prefetch': int (async-pipeline staging depth in batches, "
+        "0 = serial staging; default 2), 'sync_every': int (steps "
+        "between deferred device syncs; default 8 — see docs/PERF.md)}",
         typeConverter=TypeConverters.identity)
 
     @keyword_only
@@ -266,7 +269,11 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
             state, batches, epochs=epochs, metrics_logger=logger,
             checkpoint=checkpoint,
             checkpoint_every=int(fit_params.get("checkpoint_every", 0)),
-            on_epoch=on_epoch)
+            on_epoch=on_epoch,
+            # async input pipeline knobs (ISSUE 3, docs/PERF.md): staging
+            # depth and deferred-sync cadence of the pipelined train loop
+            prefetch=int(fit_params.get("prefetch", 2)),
+            sync_every=int(fit_params.get("sync_every", 8)))
         if checkpoint is not None:
             checkpoint.wait_until_finished()
             checkpoint.close()
@@ -280,7 +287,9 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         Replaces the reference's driver-side ``collect()`` (SURVEY.md §3.3's
         scalability cliff): partitions decode lazily through the engine and
         flow into fixed-shape train batches without materializing the
-        dataset. With ``shuffle`` rows mix through a windowed shuffle
+        dataset. The whole pull→decode→stage chain runs on ``Trainer.fit``'s
+        prefetcher thread (ISSUE 3): partition decode for batch k+1
+        overlaps the device's training of batch k. With ``shuffle`` rows mix through a windowed shuffle
         buffer across partitions (an EXACT global permutation requires the
         collected path, ``streaming=False``); with ``shuffle=False`` the
         batch sequence is identical to the collected path's.

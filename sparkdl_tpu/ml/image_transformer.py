@@ -13,6 +13,13 @@ program:
 and execution is the engine's partition-parallel ``withColumnBatch`` — one
 ``device_put`` per partition chunk, fixed batch shapes via padding so XLA
 compiles once per batch size.
+
+Async pipeline (ISSUE 3): within a partition, ``apply_batch`` stages
+chunk ``k+1`` (the pad copies) on a background prefetcher thread while
+chunk ``k``'s transfer+compute is in flight (``_PREFETCH_DEPTH``), and
+the engine's partition pool overlaps one partition's host decode with
+another's device work — the featurize-path adoption of the same
+``core.pipeline.DevicePrefetcher`` the Trainer uses.
 """
 
 from __future__ import annotations
@@ -42,6 +49,10 @@ from sparkdl_tpu.param.shared_params import (
 )
 
 OUTPUT_MODES = ("vector", "image")
+
+# Chunk-staging depth of the async input pipeline inside apply_batch
+# (core/pipeline.py); 0 falls back to inline serial staging.
+_PREFETCH_DEPTH = 2
 
 
 class TPUImageTransformer(Transformer, HasInputCol, HasOutputCol,
@@ -140,7 +151,8 @@ class TPUImageTransformer(Transformer, HasInputCol, HasOutputCol,
                                                           run)
                 with profiling.annotate("sparkdl.device_apply"):
                     out = run_fast.apply_batch(stacked, batch_size=batch_size,
-                                               mesh=mesh)
+                                               mesh=mesh,
+                                               prefetch=_PREFETCH_DEPTH)
                 if mode == "vector":
                     return _vectors_with_nulls(out, valid, batch.num_rows)
                 origins = col.field("origin").take(
@@ -172,7 +184,7 @@ class TPUImageTransformer(Transformer, HasInputCol, HasOutputCol,
                 return pa.array([None] * batch.num_rows, type=out_type)
             with profiling.annotate("sparkdl.device_apply"):
                 out = run.apply_batch(stacked, batch_size=batch_size,
-                                      mesh=mesh)
+                                      mesh=mesh, prefetch=_PREFETCH_DEPTH)
             if mode == "vector":
                 return _vectors_with_nulls(out, valid, batch.num_rows)
             return _images_with_nulls(out, valid, batch.num_rows,

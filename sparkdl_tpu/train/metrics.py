@@ -37,6 +37,17 @@ class MetricsLogger:
 
     A sink is ``callable(record: dict) -> None``. ``jsonl`` writes one JSON
     object per record to the given stream (stdout default).
+
+    Async-pipeline contract (core/pipeline.py): ``log_step(...,
+    defer=True)`` accepts STILL-ON-DEVICE metric values without touching
+    them — converting a device scalar to float blocks until the step's
+    XLA program finishes, which would re-serialize the pipelined train
+    loop. Deferred records queue up and materialize in one batched fetch
+    at :meth:`flush`, which ``Trainer.fit`` calls only at its designated
+    sync points. ``examples_per_sec`` on deferred records is the
+    steady-state rate over the flush window (examples since last flush /
+    wall seconds since last flush) — per-step dispatch intervals would
+    measure host loop time, not step time.
     """
 
     def __init__(self, sinks: Optional[List[Callable]] = None,
@@ -49,21 +60,56 @@ class MetricsLogger:
         self.every = max(1, every)
         self.history: List[Dict[str, Any]] = []
         self._t_last: Optional[float] = None
+        self._pending: List[tuple] = []  # (step, device_metrics, examples)
 
-    def log_step(self, step: int, metrics: Dict[str, Any],
-                 examples: Optional[int] = None) -> Dict[str, Any]:
-        now = time.perf_counter()
-        record = {"step": int(step)}
+    def _materialize(self, step: int, metrics: Dict[str, Any],
+                     rate: Optional[float]) -> Dict[str, Any]:
+        """Shared record building for the inline and deferred paths: float
+        conversion, history append, and ``every``-gated sink dispatch."""
+        record: Dict[str, Any] = {"step": int(step)}
         for k, v in metrics.items():
             record[k] = float(v) if hasattr(v, "item") or isinstance(
                 v, (int, float)) else v
-        if examples is not None and self._t_last is not None:
-            dt = now - self._t_last
-            if dt > 0:
-                record["examples_per_sec"] = examples / dt
-        self._t_last = now
+        if rate is not None:
+            record["examples_per_sec"] = rate
         self.history.append(record)
         if step % self.every == 0:
             for sink in self.sinks:
                 sink(record)
         return record
+
+    def log_step(self, step: int, metrics: Dict[str, Any],
+                 examples: Optional[int] = None,
+                 defer: bool = False) -> Optional[Dict[str, Any]]:
+        if defer:
+            self._pending.append((int(step), metrics, examples))
+            return None
+        now = time.perf_counter()
+        rate = None
+        if examples is not None and self._t_last is not None:
+            dt = now - self._t_last
+            if dt > 0:
+                rate = examples / dt
+        self._t_last = now
+        return self._materialize(step, metrics, rate)
+
+    def flush(self) -> List[Dict[str, Any]]:
+        """Materialize deferred records (ONE batched device fetch), append
+        them to history in step order and forward due ones to sinks.
+        Returns the flushed records. This is a device barrier for every
+        step logged since the previous flush — call it at sync points."""
+        if not self._pending:
+            return []
+        pending, self._pending = self._pending, []
+        now = time.perf_counter()
+        fetched = jax.device_get([m for _, m, _ in pending])
+        window_examples = sum(e for _, _, e in pending if e is not None)
+        rate = None
+        if self._t_last is not None and window_examples:
+            dt = now - self._t_last
+            if dt > 0:
+                rate = window_examples / dt
+        self._t_last = now
+        return [self._materialize(step, metrics,
+                                  rate if examples is not None else None)
+                for (step, _, examples), metrics in zip(pending, fetched)]

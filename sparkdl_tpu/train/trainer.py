@@ -25,7 +25,7 @@ import numpy as np
 import optax
 from flax import struct
 
-from sparkdl_tpu.core import health, profiling, resilience
+from sparkdl_tpu.core import health, pipeline, profiling, resilience
 from sparkdl_tpu.core.mesh import batch_sharding, replicated
 from sparkdl_tpu.train.checkpoint import CheckpointManager
 from sparkdl_tpu.train.metrics import MetricsLogger
@@ -387,9 +387,10 @@ class Trainer:
             checkpoint_every: int = 0,
             resume: bool = True,
             on_step: Optional[Callable[[int], None]] = None,
-            on_epoch: Optional[Callable[[int, TrainState], None]] = None
-            ) -> TrainState:
-        """Run the train loop; resume from the latest checkpoint if present.
+            on_epoch: Optional[Callable[[int, TrainState], None]] = None,
+            sync_every: int = 8,
+            prefetch: int = 2) -> TrainState:
+        """Run the pipelined train loop; resume from the latest checkpoint.
 
         ``batches``: a reiterable of ``(x, y)`` numpy pairs (all the same
         shape — pad or drop the remainder upstream; static shapes keep one
@@ -398,6 +399,26 @@ class Trainer:
         worker loss would, and TPURunner restarts from the checkpoint.
         ``on_epoch(epoch_index, state)`` fires after each epoch (the
         estimator's validation-evaluation hook).
+
+        Async input pipeline (ISSUE 3, docs/PERF.md): host pull + decode +
+        staging for batch ``k+1`` runs on a background thread
+        (``core.pipeline.DevicePrefetcher``, ``prefetch`` staged batches
+        deep; 0 = inline serial staging) while the device trains batch
+        ``k``, and the loop never blocks on the device per step — the
+        step counter is tracked on the HOST (the device chain is
+        deterministic, so they agree) and the device is only awaited at
+        the designated sync points: every ``sync_every`` steps, at
+        checkpoint writes, before each ``on_step`` call (so the hook's
+        contract — "the step has completed" — survives), and at epoch
+        boundaries. Per-step metrics defer on device and materialize at
+        sync points (``MetricsLogger.flush``). Batch values, order, RNG
+        chain and donation semantics are untouched, so a pipelined fit is
+        bit-identical to the serial loop, and exact resume still replays
+        to the precise next batch (skipped positions are never staged).
+        ``sync_every`` also bounds in-flight device work (each unsynced
+        step holds its staged batch alive): raise it to hide slow hosts
+        deeper, lower it to cap device memory and tighten failure
+        detection latency.
         """
         if checkpoint is not None and resume:
             latest = checkpoint.latest_step()
@@ -407,6 +428,17 @@ class Trainer:
                 health.record(health.FIT_RESUMED, step=int(state.step))
         train_step = self.make_train_step()
         multihost = self.mesh is not None and jax.process_count() > 1
+        if jax.process_count() > 1:
+            # Multi-process: force inline staging. The batch source may run
+            # per-batch collectives (the streaming estimator's lockstep
+            # allgather) and stage_batch assembles global arrays — enqueued
+            # from a staging thread they would interleave with the main
+            # thread's train-step collectives in a scheduler-dependent
+            # order that can DIVERGE across processes and hang the gang.
+            # One thread per process keeps every host's collective order
+            # identical to the serial loop's; deferred step sync (the
+            # host-side win) still applies.
+            prefetch = 0
         if self.mesh is not None:
             state = jax.device_put(state, replicated(self.mesh))
 
@@ -431,39 +463,88 @@ class Trainer:
                 out = out.astype(jnp.float32)
             return out
 
+        def stage_pair(pair):
+            """Staging-thread stage: host (x, y) → (n_examples, xd, yd)."""
+            x, y = pair
+            with profiling.annotate(profiling.STAGE_BATCH):
+                return len(x), stage_batch(x), stage_batch(y)
+
         # Exact resume: the loop replays the (deterministic) batch stream and
         # skips the first `state.step` positions — mid-epoch restarts land on
         # the precise next batch.
         done = int(state.step)
+        host_step = done
         global_idx = 0
+        sync_every = max(1, int(sync_every))
+
+        def sync(st: TrainState) -> None:
+            """Designated sync point — the ONLY place the step loop blocks
+            on the device (enforced by the AST lint in
+            tests/test_taxonomy_lint.py). Drains deferred metrics (one
+            batched fetch), then barriers on the device step counter — a
+            scalar fetch, the reliable barrier under the remote tunnel
+            (core/profiling.py; cross-dispatch block_until_ready is not).
+            """
+            if metrics_logger is not None:
+                metrics_logger.flush()
+            with profiling.annotate(profiling.DEVICE_SYNC):
+                device_step = int(st.step)
+            if device_step != host_step:
+                raise RuntimeError(
+                    f"pipelined fit desynchronized: device step "
+                    f"{device_step} != host-tracked step {host_step} — "
+                    "the batch stream or state chain was tampered with "
+                    "mid-fit")
+
+        def save_checkpoint(st: TrainState) -> None:
+            checkpoint.save(host_step, jax.device_get(st))
+
+        def epoch_source():
+            # runs on the staging thread: resume-skipped positions are
+            # counted but never staged (no wasted device_put on replay)
+            nonlocal global_idx
+            for pair in batches:
+                if global_idx < done:
+                    global_idx += 1
+                    continue
+                global_idx += 1
+                yield pair
+
         try:
             for _epoch in range(epochs):
-                for x, y in batches:
-                    if global_idx < done:
-                        global_idx += 1
-                        continue
-                    # int(state.step) inside the span: it is the per-step
-                    # sync point, so the timer records real step time, not
-                    # just the async dispatch.
-                    with profiling.annotate("sparkdl.train_step"):
-                        state, metrics = train_step(state, stage_batch(x),
-                                                    stage_batch(y))
-                        step = int(state.step)
-                    global_idx += 1
-                    if metrics_logger is not None:
-                        metrics_logger.log_step(step, metrics,
-                                                examples=len(x))
-                    if (checkpoint is not None and checkpoint_every
-                            and step % checkpoint_every == 0):
-                        checkpoint.save(step, jax.device_get(state))
-                    if on_step is not None:
-                        on_step(step)
-                    # Injection point AFTER the checkpoint write: a
-                    # preemption here models losing the gang between steps
-                    # — TPURunner classifies it retryable, restarts, and
-                    # this loop's resume path replays from the step just
-                    # saved (SURVEY.md §5.3).
-                    resilience.inject("preemption", step=step)
+                with pipeline.DevicePrefetcher(
+                        epoch_source(), stage_fn=stage_pair,
+                        depth=prefetch, name="trainer.fit",
+                        report_health=True) as staged:
+                    for n_examples, xd, yd in staged:
+                        # dispatch only — execution is awaited at sync
+                        # points (DEVICE_SYNC carries the blocking time)
+                        with profiling.annotate("sparkdl.train_step"):
+                            state, metrics = train_step(state, xd, yd)
+                        host_step += 1
+                        if metrics_logger is not None:
+                            metrics_logger.log_step(host_step, metrics,
+                                                    examples=n_examples,
+                                                    defer=True)
+                        due_ckpt = (checkpoint is not None and
+                                    checkpoint_every and
+                                    host_step % checkpoint_every == 0)
+                        if (due_ckpt or on_step is not None
+                                or host_step % sync_every == 0):
+                            sync(state)
+                        if due_ckpt:
+                            save_checkpoint(state)
+                        if on_step is not None:
+                            on_step(host_step)
+                        # Injection point AFTER the checkpoint write: a
+                        # preemption here models losing the gang between
+                        # steps — TPURunner classifies it retryable,
+                        # restarts, and this loop's resume path replays
+                        # from the step just saved (SURVEY.md §5.3).
+                        resilience.inject("preemption", step=host_step)
+                # epoch boundary is a designated sync point: on_epoch
+                # observes a fully-materialized state and complete metrics
+                sync(state)
                 if on_epoch is not None:
                     on_epoch(_epoch, state)
         except BaseException:
@@ -471,7 +552,15 @@ class Trainer:
             # flight. Flush them before unwinding so (a) the restarted
             # attempt's latest_step() sees every step this attempt
             # completed (no redone work) and (b) an abandoned async write
-            # can't race the restart's save of the same step.
+            # can't race the restart's save of the same step. Deferred
+            # metrics flush best-effort (their steps may be the ones that
+            # failed); the staging thread is already closed by the
+            # prefetcher's context manager.
+            if metrics_logger is not None:
+                try:
+                    metrics_logger.flush()
+                except Exception:  # noqa: BLE001 - already unwinding
+                    pass
             if checkpoint is not None:
                 try:
                     checkpoint.wait_until_finished()
@@ -479,9 +568,9 @@ class Trainer:
                     pass
             raise
         if checkpoint is not None:
-            checkpoint.save(int(state.step), jax.device_get(state),
+            checkpoint.save(host_step, jax.device_get(state),
                             synchronous=True)
-        health.record(health.FIT_COMPLETED, steps=int(state.step))
+        health.record(health.FIT_COMPLETED, steps=host_step)
         return state
 
     def variables_of(self, state: TrainState) -> Dict[str, Any]:

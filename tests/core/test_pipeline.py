@@ -1,0 +1,244 @@
+"""DevicePrefetcher: ordering, bounded depth, error propagation, clean
+shutdown, counters, and genuine cross-thread staging overlap (ISSUE 3).
+"""
+
+import threading
+import time
+
+import pytest
+
+from sparkdl_tpu.core import health, pipeline, profiling
+from sparkdl_tpu.core.health import HealthMonitor
+from sparkdl_tpu.core.pipeline import DevicePrefetcher
+
+
+def _prefetch_threads():
+    return [t for t in threading.enumerate()
+            if t.name.startswith("sparkdl-prefetch")]
+
+
+def _wait_no_prefetch_threads(timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if not _prefetch_threads():
+            return True
+        time.sleep(0.01)
+    return not _prefetch_threads()
+
+
+def test_order_preserved_and_stage_fn_applied():
+    with DevicePrefetcher(range(50), stage_fn=lambda i: i * 2,
+                          depth=3) as pf:
+        assert list(pf) == [i * 2 for i in range(50)]
+    assert pf.stats.staged == 50
+    assert pf.stats.consumed == 50
+    assert _wait_no_prefetch_threads()
+
+
+def test_depth_zero_is_inline_no_thread():
+    before = _prefetch_threads()
+    pf = DevicePrefetcher(range(10), stage_fn=lambda i: i + 1, depth=0)
+    assert _prefetch_threads() == before  # no staging thread created
+    assert list(pf) == list(range(1, 11))
+    assert pf.stats.staged == 10
+
+
+def test_inline_staging_counts_as_host_wait():
+    """The serial (depth=0) path is 100% starvation: its whole pull+stage
+    time feeds HOST_WAIT, so overlap_ratio reports ~0 for a serial run —
+    not a phantom 'fully hidden' 1.0."""
+    profiling.reset_phase_stats()
+
+    def slow_stage(i):
+        time.sleep(0.01)
+        with profiling.annotate("sparkdl.stage"):
+            time.sleep(0.005)
+        return i
+
+    with DevicePrefetcher(range(4), stage_fn=slow_stage, depth=0) as pf:
+        assert list(pf) == [0, 1, 2, 3]
+    assert pf.stats.stalls == 4
+    assert pf.stats.stall_s >= 0.04
+    stats = profiling.overlap_stats()
+    assert stats["host_wait_s"] >= stats["host_etl_s"] > 0
+    assert stats["overlap_ratio"] == 0.0
+
+
+def test_producer_bounded_by_depth():
+    """The staging thread runs at most depth staged-and-queued items plus
+    the one it holds while blocked on put — never the whole stream."""
+    produced = []
+
+    def source():
+        for i in range(100):
+            produced.append(i)
+            yield i
+
+    pf = DevicePrefetcher(source(), depth=2)
+    try:
+        deadline = time.monotonic() + 5.0
+        while len(produced) < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        time.sleep(0.15)  # give an unbounded producer time to run away
+        assert 3 <= len(produced) <= 4  # depth(2) queued + ≤2 in hand/flight
+        assert next(pf) == 0  # stream still delivers, in order
+    finally:
+        pf.close()
+    assert _wait_no_prefetch_threads()
+
+
+def test_error_propagates_with_thread_joined():
+    class Boom(RuntimeError):
+        pass
+
+    def source():
+        yield 1
+        yield 2
+        raise Boom("decode failed mid-stream")
+
+    pf = DevicePrefetcher(source(), depth=2, name="err")
+    assert next(pf) == 1
+    assert next(pf) == 2
+    with pytest.raises(Boom, match="decode failed"):
+        next(pf)
+    # fully drained: thread joined, iteration stays terminated
+    assert _wait_no_prefetch_threads()
+    with pytest.raises(StopIteration):
+        next(pf)
+
+
+def test_stage_fn_error_propagates():
+    def bad_stage(i):
+        if i == 3:
+            raise ValueError("bad batch 3")
+        return i
+
+    with DevicePrefetcher(range(10), stage_fn=bad_stage, depth=1) as pf:
+        got = [next(pf), next(pf), next(pf)]
+        with pytest.raises(ValueError, match="bad batch 3"):
+            for item in pf:
+                got.append(item)
+    assert got == [0, 1, 2]
+    assert _wait_no_prefetch_threads()
+
+
+def test_close_midstream_wakes_blocked_producer():
+    staged = []
+
+    def source():
+        for i in range(1000):
+            staged.append(i)
+            yield i
+
+    pf = DevicePrefetcher(source(), depth=1)
+    assert next(pf) == 0
+    pf.close()  # producer is blocked on a full queue right now
+    assert _wait_no_prefetch_threads()
+    assert len(staged) < 1000  # source was NOT exhausted after close
+    with pytest.raises(StopIteration):
+        next(pf)
+    pf.close()  # idempotent
+
+
+def test_stall_counters_feed_host_wait_phase():
+    profiling.reset_phase_stats()
+
+    def slow_source():
+        for i in range(3):
+            time.sleep(0.03)
+            yield i
+
+    with DevicePrefetcher(slow_source(), depth=2, name="slow") as pf:
+        assert list(pf) == [0, 1, 2]
+    assert pf.stats.stalls >= 1  # consumer outran the slow host at least once
+    assert pf.stats.stall_s > 0
+    stats = profiling.phase_stats()
+    assert profiling.HOST_WAIT in stats
+    assert stats[profiling.HOST_WAIT]["total_s"] == pytest.approx(
+        pf.stats.stall_s, rel=0.01)
+
+
+def test_overlap_stats_ratio_bounds():
+    profiling.reset_phase_stats()
+    profiling.add_phase_time("sparkdl.decode", 2.0)
+    profiling.add_phase_time(profiling.HOST_WAIT, 0.5)
+    stats = profiling.overlap_stats()
+    assert stats["host_etl_s"] == pytest.approx(2.0)
+    assert stats["host_wait_s"] == pytest.approx(0.5)
+    assert stats["overlap_ratio"] == pytest.approx(0.75)
+    profiling.reset_phase_stats()
+    assert profiling.overlap_stats()["overlap_ratio"] == 1.0
+
+
+def test_health_report_recorded_per_stream():
+    with HealthMonitor("pf") as mon:
+        with DevicePrefetcher(range(5), depth=2, name="telemetry",
+                              report_health=True) as pf:
+            assert len(list(pf)) == 5
+    events = mon.events(health.PREFETCH_REPORT)
+    assert len(events) == 1
+    assert events[0]["name"] == "telemetry"
+    assert events[0]["staged"] == 5
+    assert events[0]["consumed"] == 5
+
+
+def test_health_report_off_by_default():
+    """Per-chunk streams (run_batched) must NOT emit one event each —
+    thousands of them would evict later quarantine/retry entries from
+    HealthMonitor's bounded event log."""
+    with HealthMonitor("quiet") as mon:
+        with DevicePrefetcher(range(5), depth=2) as pf:
+            assert len(list(pf)) == 5
+    assert mon.events(health.PREFETCH_REPORT) == []
+    assert pf.stats.consumed == 5  # stats still tracked
+
+
+def test_staging_runs_concurrently_with_consumer():
+    """Genuine overlap: the staging thread produces item k+1 WHILE the
+    consumer holds item k un-returned — proven by event ordering, not
+    timing."""
+    main = threading.get_ident()
+    producer_threads = []
+    second_staged = threading.Event()
+
+    def source():
+        for i in range(4):
+            producer_threads.append(threading.get_ident())
+            yield i
+            if i == 1:
+                second_staged.set()
+
+    with DevicePrefetcher(source(), depth=2) as pf:
+        first = next(pf)  # consumer now "works on" item 0...
+        # ...while the producer keeps staging ahead on its own thread
+        assert second_staged.wait(timeout=5.0)
+        assert first == 0
+        assert list(pf) == [1, 2, 3]
+    assert all(t != main for t in producer_threads)
+    assert pf.stats.ready_hits >= 1  # at least one item was staged ahead
+
+
+@pytest.mark.slow
+def test_stress_many_streams_no_thread_leak():
+    """Stress: hundreds of short-lived streams (the per-epoch / per-
+    partition usage pattern) leave no threads behind, including streams
+    abandoned mid-flight and streams that error."""
+    for i in range(200):
+        mode = i % 3
+        if mode == 0:
+            with DevicePrefetcher(range(20), depth=2) as pf:
+                assert len(list(pf)) == 20
+        elif mode == 1:
+            pf = DevicePrefetcher(iter(range(50)), depth=3)
+            next(pf)
+            pf.close()  # abandoned mid-flight
+        else:
+            def bad():
+                yield 1
+                raise RuntimeError("x")
+
+            pf = DevicePrefetcher(bad(), depth=1)
+            next(pf)
+            with pytest.raises(RuntimeError):
+                next(pf)
+    assert _wait_no_prefetch_threads(timeout=10.0)

@@ -105,8 +105,17 @@ def _run_pipeline(image_dir, ckpt_dir):
                                            optimizer="sgd",
                                            learning_rate=0.1, mesh=mesh)
         ckpt = CheckpointManager(str(ckpt_dir))
+        # prefetch staging explicitly ON (ISSUE 3): the chaos composition
+        # must survive background staging with identical health counts and
+        # bit-identical outputs (assertions below are unchanged). NOTE:
+        # on_step + checkpoint_every=1 force a sync every step here, so
+        # this exercises the staging thread, not deferred sync; the
+        # genuinely-deferred abort path (preemption between sync points)
+        # is covered by tests/train/test_pipeline_fit.py::
+        # test_preemption_abort_with_deferred_sync_resumes_exact
         state = trainer.fit(state, batches, epochs=2, checkpoint=ckpt,
-                            checkpoint_every=1, on_step=steps_run.append)
+                            checkpoint_every=1, on_step=steps_run.append,
+                            prefetch=2, sync_every=2)
         ckpt.wait_until_finished()
         ckpt.close()
         return jax.device_get(state)

@@ -104,3 +104,110 @@ def test_lint_catches_the_old_blind_retry_shape():
     v = _LoopHandlerVisitor(bad.splitlines())
     v.visit(tree)
     assert v.violations == [5]
+
+
+# ---------------------------------------------------------------------------
+# Async-pipeline lint (ISSUE 3): Trainer.fit's step loop must never block
+# on the device outside the designated sync helpers. A blocking fetch —
+# `int(...)` / `float(...)` on a device scalar, `np.asarray`,
+# `jax.device_get`, `block_until_ready` — inside the loop body
+# re-serializes host staging with device compute (the exact regression the
+# DevicePrefetcher removed). Blocking fetches belong in the pre-loop
+# helper closures (`sync` / `save_checkpoint`), which the loop calls only
+# at sync points; nested function DEFINITIONS are therefore exempt, direct
+# calls in the loop body are not.
+# ---------------------------------------------------------------------------
+
+_BLOCKING_NAMES = {"int", "float"}
+_BLOCKING_ATTRS = {"asarray", "device_get", "block_until_ready"}
+
+
+def _blocking_calls_in_fit_loops(tree: ast.AST):
+    """Lines of blocking-fetch calls inside Trainer.fit's own loops."""
+    fit = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "Trainer":
+            for item in node.body:
+                if isinstance(item, ast.FunctionDef) and item.name == "fit":
+                    fit = item
+    assert fit is not None, "Trainer.fit not found"
+
+    class _LoopFinder(ast.NodeVisitor):
+        """Collect fit's own loops, NOT those inside nested functions
+        (helper closures run off the hot path or at sync points)."""
+
+        def __init__(self):
+            self.loops = []
+
+        def visit_FunctionDef(self, node):
+            if node is not fit:
+                return  # don't descend into nested defs
+            self.generic_visit(node)
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def _loop(self, node):
+            self.loops.append(node)
+            self.generic_visit(node)
+
+        visit_For = visit_While = visit_AsyncFor = _loop
+
+    finder = _LoopFinder()
+    finder.visit(fit)
+    assert finder.loops, "Trainer.fit has no step loop?"
+
+    def _walk_pruned(node):
+        """ast.walk, but do not descend into nested function definitions:
+        a def inside the loop only BLOCKS if called there — its call-site
+        is what we check."""
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            yield child
+            yield from _walk_pruned(child)
+
+    violations = []
+    for loop in finder.loops:
+        for node in _walk_pruned(loop):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Name) and f.id in _BLOCKING_NAMES:
+                violations.append(node.lineno)
+            elif isinstance(f, ast.Attribute) and f.attr in _BLOCKING_ATTRS:
+                violations.append(node.lineno)
+    return sorted(set(violations))
+
+
+def test_trainer_step_loop_has_no_blocking_device_fetch():
+    path = ROOT / "train" / "trainer.py"
+    tree = ast.parse(path.read_text(), filename=str(path))
+    offenders = _blocking_calls_in_fit_loops(tree)
+    assert not offenders, (
+        "blocking device fetch inside Trainer.fit's step loop (lines "
+        f"{offenders} of train/trainer.py) — int()/float()/np.asarray/"
+        "jax.device_get/block_until_ready there re-serialize the async "
+        "input pipeline. Move the fetch into the designated sync helpers "
+        "(sync/save_checkpoint) and call them only at sync points.")
+
+
+def test_lint_catches_the_old_per_step_sync_shape():
+    """Self-test: the pre-pipeline loop body (`step = int(state.step)`
+    per step, plus a device_get checkpoint fetch) must trip the lint —
+    while helper DEFINITIONS (pre-loop or even inside the loop) stay
+    exempt: only their call-sites block."""
+    bad = (
+        "class Trainer:\n"
+        "    def fit(self, state, batches):\n"
+        "        def sync(st):\n"
+        "            return int(st.step)\n"  # pre-loop helper: exempt
+        "        for x, y in batches:\n"
+        "            def fetch():\n"
+        "                return int(state.step)\n"  # nested DEF: exempt
+        "            state, m = step(state, x, y)\n"
+        "            step_n = int(state.step)\n"  # line 9: violation
+        "            ckpt.save(step_n, jax.device_get(state))\n"  # line 10
+        "        return state\n"
+    )
+    assert _blocking_calls_in_fit_loops(ast.parse(bad)) == [9, 10]

@@ -1,0 +1,269 @@
+"""Pipelined Trainer.fit (ISSUE 3): bit-identical to the serial loop,
+exact resume under deferred sync, genuine staging/compute overlap, and
+failure paths (staging errors propagate, no leaked threads, checkpoints
+flushed)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import flax.linen as nn
+
+from sparkdl_tpu.train import CheckpointManager, MetricsLogger, Trainer
+
+
+class TinyMLP(nn.Module):
+    classes: int = 3
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Dense(16)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.classes)(x)
+        return jax.nn.softmax(x, axis=-1)
+
+
+def _toy_data(n=64, d=6, classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=(d, classes)).astype(np.float32)
+    y = np.eye(classes, dtype=np.float32)[np.argmax(x @ w, axis=1)]
+    return x, y
+
+
+def _batches(x, y, bs):
+    return [(x[i:i + bs], y[i:i + bs]) for i in range(0, len(x) - bs + 1, bs)]
+
+
+def _make(seed=0):
+    x, y = _toy_data()
+    module = TinyMLP()
+    variables = module.init(jax.random.PRNGKey(seed), x[:1])
+    trainer, state = Trainer.from_flax(module, variables, optimizer="sgd",
+                                       learning_rate=0.1)
+    return trainer, state, _batches(x, y, 16)
+
+
+def _leaves(tree):
+    return [np.asarray(a) for a in jax.tree.leaves(jax.device_get(tree))]
+
+
+def test_pipelined_fit_bit_identical_to_serial_loop():
+    """Acceptance: the pipelined fit (prefetch + deferred sync) produces
+    a final state BIT-IDENTICAL to a hand-rolled serial reference loop —
+    params AND opt_state."""
+    trainer, state_p, batches = _make()
+    fitted = trainer.fit(state_p, batches, epochs=3, sync_every=3,
+                         prefetch=2)
+
+    # serial reference: same init, same jitted step, one blocking step at
+    # a time (the pre-pipeline behavior)
+    _, state_s, _ = _make()
+    import jax.numpy as jnp
+
+    step = trainer.make_train_step()
+    for _ in range(3):
+        for x, y in batches:
+            state_s, _ = step(state_s, jnp.asarray(x), jnp.asarray(y))
+            _ = int(state_s.step)  # per-step barrier
+
+    assert int(fitted.step) == int(state_s.step) == 12
+    for a, b in zip(_leaves(fitted.params), _leaves(state_s.params)):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(_leaves(fitted.opt_state), _leaves(state_s.opt_state)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_pipelined_fit_matches_serial_fit_settings():
+    """prefetch=0 / sync_every=1 (the serial configuration) and the
+    pipelined defaults agree bitwise — the knobs change scheduling only."""
+    trainer, s1, batches = _make()
+    f1 = trainer.fit(s1, batches, epochs=2, sync_every=1, prefetch=0)
+    _, s2, _ = _make()
+    f2 = trainer.fit(s2, batches, epochs=2, sync_every=7, prefetch=3)
+    for a, b in zip(_leaves(f1.params), _leaves(f2.params)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_exact_resume_under_deferred_sync(tmp_path):
+    """Acceptance: resume lands on the precise next batch with NO per-step
+    sync (no on_step hook) — a partial fit's checkpoint continued to the
+    full epoch count matches the uninterrupted fit bitwise."""
+    trainer, ref_state, batches = _make()
+    ref = trainer.fit(ref_state, batches, epochs=2, sync_every=3, prefetch=2)
+
+    _, s_a, _ = _make()
+    ckpt = CheckpointManager(str(tmp_path / "ckpt"))
+    partial = trainer.fit(s_a, batches, epochs=1, checkpoint=ckpt,
+                          checkpoint_every=3, sync_every=3, prefetch=2)
+    assert int(partial.step) == 4
+    assert ckpt.latest_step() == 4
+    _, s_b, _ = _make()  # scratch-shaped state; fit restores + replays
+    resumed = trainer.fit(s_b, batches, epochs=2, checkpoint=ckpt,
+                          sync_every=3, prefetch=2)
+    ckpt.close()
+    assert int(resumed.step) == 8
+    for a, b in zip(_leaves(ref.params), _leaves(resumed.params)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_staging_overlaps_training_steps():
+    """Acceptance: staging-thread work observed while a step is in
+    flight. Event-ordered (no timing): the source stages batch k+1 only
+    after the main loop has DISPATCHED step k without syncing — possible
+    only if staging runs on a separate thread concurrently with the
+    un-awaited device work."""
+    trainer, state, batches = _make()
+    main = threading.get_ident()
+    dispatched = threading.Event()
+    overlapped = threading.Event()
+    source_threads = []
+
+    class Stream:
+        def __iter__(self):
+            for i, pair in enumerate(batches):
+                source_threads.append(threading.get_ident())
+                if i >= 1:
+                    # step i-1 was dispatched and NOT synced (sync_every
+                    # exceeds the batch count, no on_step, no checkpoint)
+                    if dispatched.wait(timeout=10.0):
+                        overlapped.set()
+                yield pair
+
+    class Logger(MetricsLogger):
+        def log_step(self, step, metrics, examples=None, defer=False):
+            dispatched.set()
+            return super().log_step(step, metrics, examples=examples,
+                                    defer=defer)
+
+    logger = Logger(sinks=[lambda r: None])
+    fitted = trainer.fit(state, Stream(), epochs=1, metrics_logger=logger,
+                         sync_every=100, prefetch=2)
+    assert overlapped.is_set()
+    assert all(t != main for t in source_threads)  # staged off-thread
+    assert int(fitted.step) == len(batches)
+    # deferred metrics all materialized at the epoch-boundary sync
+    assert [r["step"] for r in logger.history] == [1, 2, 3, 4]
+    assert all(isinstance(r["loss"], float) for r in logger.history)
+
+
+def test_serial_fallback_stages_on_main_thread():
+    trainer, state, batches = _make()
+    main = threading.get_ident()
+    source_threads = []
+
+    class Stream:
+        def __iter__(self):
+            for pair in batches:
+                source_threads.append(threading.get_ident())
+                yield pair
+
+    trainer.fit(state, Stream(), epochs=1, prefetch=0)
+    assert all(t == main for t in source_threads)
+
+
+def test_stream_error_propagates_and_flushes(tmp_path):
+    """Acceptance: an exception raised mid-stream by the staging thread
+    propagates to the fit caller with the prefetcher fully drained (no
+    leaked thread, no swallowed error) and pending checkpoints flushed."""
+
+    class DecodeBoom(RuntimeError):
+        pass
+
+    trainer, state, batches = _make()
+    ckpt = CheckpointManager(str(tmp_path / "ckpt"))
+
+    class Stream:
+        def __iter__(self):
+            yield batches[0]
+            yield batches[1]
+            raise DecodeBoom("partition 2 unreadable")
+
+    with pytest.raises(DecodeBoom, match="partition 2 unreadable"):
+        trainer.fit(state, Stream(), epochs=1, checkpoint=ckpt,
+                    checkpoint_every=1, sync_every=100, prefetch=2)
+    # both completed steps were checkpointed and the async writes flushed
+    assert ckpt.latest_step() == 2
+    ckpt.close()
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if not [t for t in threading.enumerate()
+                if t.name.startswith("sparkdl-prefetch")]:
+            break
+        time.sleep(0.01)
+    assert not [t for t in threading.enumerate()
+                if t.name.startswith("sparkdl-prefetch")]
+
+
+def test_deferred_metrics_rate_is_window_averaged():
+    trainer, state, batches = _make()
+    logger = MetricsLogger(sinks=[lambda r: None])
+    trainer.fit(state, batches, epochs=2, metrics_logger=logger,
+                sync_every=4, prefetch=2)
+    assert len(logger.history) == 8
+    # first flush window has no prior timestamp → no rate; later ones do
+    assert any("examples_per_sec" in r for r in logger.history[4:])
+
+
+def test_preemption_abort_with_deferred_sync_resumes_exact(tmp_path):
+    """The chaos e2e runs with per-step syncs (on_step + checkpoint_every=1
+    force them); this covers the genuinely-deferred case: preemption fires
+    at a step with NO sync due (not checkpoint-due, not sync_every-due,
+    no on_step), so the abort unwinds with un-flushed deferred metrics and
+    un-awaited in-flight steps — pending checkpoint writes must flush and
+    the checkpoint-resumed continuation must match the uninterrupted fit
+    bitwise."""
+    from sparkdl_tpu.core.resilience import (Fault, FaultInjector,
+                                             InjectedFault)
+
+    trainer, ref_state, batches = _make()
+    ref = trainer.fit(ref_state, batches, epochs=2, sync_every=8, prefetch=2)
+
+    _, s_a, _ = _make()
+    ckpt = CheckpointManager(str(tmp_path / "c"))
+    logger = MetricsLogger(sinks=[lambda r: None])
+    with FaultInjector.seeded(
+            0, preemption=Fault(when=lambda c: c["step"] == 3)) as inj:
+        with pytest.raises(InjectedFault):
+            trainer.fit(s_a, batches, epochs=2, checkpoint=ckpt,
+                        checkpoint_every=2, sync_every=8, prefetch=2,
+                        metrics_logger=logger)
+    assert inj.fired["preemption"] == 1
+    assert ckpt.latest_step() == 2  # step 3 was not checkpoint-due
+    # abort-path flush materialized the deferred records for steps 1-3
+    assert [r["step"] for r in logger.history] == [1, 2, 3]
+
+    _, s_b, _ = _make()
+    resumed = trainer.fit(s_b, batches, epochs=2, checkpoint=ckpt,
+                          sync_every=8, prefetch=2)
+    ckpt.close()
+    assert int(resumed.step) == 8
+    for a, b in zip(_leaves(ref.params), _leaves(resumed.params)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_on_step_sees_completed_host_steps():
+    """on_step keeps its per-step contract (the fault-injection hook):
+    called once per step, in order, after the step's sync."""
+    trainer, state, batches = _make()
+    seen = []
+    trainer.fit(state, batches, epochs=2, on_step=seen.append,
+                sync_every=50, prefetch=2)
+    assert seen == list(range(1, 9))
+
+
+@pytest.mark.slow
+def test_pipelined_fit_stress_epoch_churn(tmp_path):
+    """Stress: many epochs over a tiny stream — per-epoch prefetcher
+    creation/teardown stays leak-free and the host/device step counters
+    stay in lockstep throughout (the sync() consistency check runs every
+    epoch boundary)."""
+    trainer, state, batches = _make()
+    fitted = trainer.fit(state, batches, epochs=40, sync_every=5,
+                         prefetch=2)
+    assert int(fitted.step) == 40 * len(batches)
+    assert not [t for t in threading.enumerate()
+                if t.name.startswith("sparkdl-prefetch")]
