@@ -197,10 +197,28 @@ def _write_jpegs(directory, n, rng):
     return paths
 
 
+def _hist_summary(snapshot, name):
+    """Compact {count,p50,p95,p99,min,max} from a telemetry snapshot's
+    histogram — the distribution the perf trajectory carries instead of
+    a single mean (ISSUE 4 satellite)."""
+    h = snapshot["histograms"].get(name)
+    if not h or not h["count"]:
+        return None
+    return {"count": h["count"],
+            "p50": round(h["p50"], 6), "p95": round(h["p95"], 6),
+            "p99": round(h["p99"], 6), "min": round(h["min"], 6),
+            "max": round(h["max"], 6)}
+
+
 def bench_e2e_featurize(n_images=384):
-    """Config 1 end-to-end: files -> readImages -> featurize -> collect."""
+    """Config 1 end-to-end: files -> readImages -> featurize -> collect.
+
+    The measured repeats run under a telemetry scope so the emitted
+    record carries the padding-waste gauge and the partition-task
+    duration distribution alongside the throughput mean."""
     import jax.numpy as jnp
 
+    from sparkdl_tpu.core import telemetry
     from sparkdl_tpu.image.imageIO import readImages
     from sparkdl_tpu.ml import DeepImageFeaturizer
 
@@ -217,8 +235,14 @@ def bench_e2e_featurize(n_images=384):
             out = t.transform(df).select("features").collect()
             assert len(out) == n_images
         run()  # warmup: compile + host caches
-        best, spread = _best_of(run)
-    return n_images / best, spread
+        with telemetry.Telemetry("bench_e2e_featurize") as tel:
+            best, spread = _best_of(run)
+        snap = tel.metrics.snapshot()
+    summary = {
+        "padding_waste": snap["gauges"].get(telemetry.M_PADDING_WASTE),
+        "task_duration_s": _hist_summary(snap, telemetry.M_TASK_DURATION_S),
+    }
+    return n_images / best, spread, summary
 
 
 def bench_batch_inference(name, n_images=256, size=(224, 224)):
@@ -292,8 +316,13 @@ def bench_streaming_fit(n_images=768):
     ``host_wait_s`` (starvation seconds the device-driving thread spent
     waiting on host ETL) and ``overlap_ratio`` (fraction of host ETL
     hidden behind device work; 0 = the old serial behavior) are the
-    fields that show the pipeline's win in the trajectory."""
-    from sparkdl_tpu.core import profiling
+    fields that show the pipeline's win in the trajectory.
+
+    The 3-epoch measurement runs under a telemetry scope (ISSUE 4), so
+    the emitted record also carries DISTRIBUTIONS — the steps/sec
+    histogram over sync windows, host step-dispatch intervals, prefetch
+    stall seconds — not just the throughput mean."""
+    from sparkdl_tpu.core import profiling, telemetry
     from sparkdl_tpu.engine.dataframe import DataFrame
     from sparkdl_tpu.ml import KerasImageFileEstimator
 
@@ -320,17 +349,27 @@ def bench_streaming_fit(n_images=768):
         fit(1)  # warmup: ingestion + step compile + host caches
         t1 = min(_timed(lambda: fit(1)) for _ in range(2))
         profiling.reset_phase_stats()
-        t3 = min(_timed(lambda: fit(3)) for _ in range(2))
+        with telemetry.Telemetry("bench_streaming_fit") as tel:
+            t3 = min(_timed(lambda: fit(3)) for _ in range(2))
+        snap = tel.metrics.snapshot()
         phases = {name: round(s["total_s"], 3)
                   for name, s in profiling.phase_stats().items()}
         overlap = profiling.overlap_stats()
+    tel_summary = {
+        "steps_per_sec": _hist_summary(snap, telemetry.M_STEPS_PER_SEC),
+        "step_time_s": _hist_summary(snap, telemetry.M_STEP_TIME_S),
+        "prefetch_stall_s": _hist_summary(snap,
+                                          telemetry.M_PREFETCH_STALL_S),
+        "padding_waste": snap["gauges"].get(telemetry.M_PADDING_WASTE),
+        "overlap": {k: round(v, 4) for k, v in overlap.items()},
+    }
     marginal = t3 - t1
     if marginal < 0.5:
         # if tunnel noise swamps the 2-epoch marginal, emit an explicit
         # invalid marker instead of a silently absurd rate (a poisoned
         # value would become the next round's vs_baseline)
-        return -1.0, phases, overlap
-    return 2 * n_images / marginal, phases, overlap
+        return -1.0, phases, overlap, tel_summary
+    return 2 * n_images / marginal, phases, overlap, tel_summary
 
 
 def bench_train_step(model_name, batch_size, mesh=None, compute_dtype=None):
@@ -401,9 +440,9 @@ def main():
                         "images/sec/chip", spread=round(spread, 4),
                         mfu=round(mfu, 4), runs=runs)
         if not headline_only:
-            e2e, sp = bench_e2e_featurize()
+            e2e, sp, e2e_tel = bench_e2e_featurize()
             emit("e2e images/sec (files->readImages->InceptionV3 featurize)",
-                 e2e, "images/sec", spread=round(sp, 4))
+                 e2e, "images/sec", spread=round(sp, 4), telemetry=e2e_tel)
             for name, size in (("ResNet50", (224, 224)),
                                ("Xception", (299, 299))):
                 ips, sp = bench_batch_inference(name, size=size)
@@ -412,11 +451,12 @@ def main():
             rps, sp = bench_udf()
             emit("SQL UDF rows/sec (InceptionV3 via selectExpr)",
                  rps, "rows/sec", spread=round(sp, 4))
-            sips, phases, overlap = bench_streaming_fit()
+            sips, phases, overlap, fit_tel = bench_streaming_fit()
             emit("e2e streaming fit images/sec (files->decode->MobileNetV2 "
                  "train)", sips, "images/sec", phases=phases,
                  host_wait_s=round(overlap["host_wait_s"], 3),
-                 overlap_ratio=round(overlap["overlap_ratio"], 4))
+                 overlap_ratio=round(overlap["overlap_ratio"], 4),
+                 telemetry=fit_tel)
             st, sp = bench_train_step("MobileNetV2", 64)
             st16, sp16 = bench_train_step("MobileNetV2", 64,
                                           compute_dtype="bfloat16")

@@ -12,7 +12,19 @@ Public surface mirrors the reference's ``sparkdl/__init__.py`` ``__all__``
 lazily on attribute access so that ``import sparkdl_tpu`` stays cheap.
 """
 
+import logging as _logging
+
 from sparkdl_tpu.version import __version__
+
+# Library logging etiquette: a NullHandler on the package root so the
+# framework never prints "No handlers could be found" noise, and apps
+# that DON'T configure logging see no output changes. Every module
+# logger uses ``logging.getLogger(__name__)``, so all framework records
+# route under the ``sparkdl_tpu`` namespace (enforced by
+# tests/test_logging.py) — one knob configures the whole library, and
+# the telemetry scope's structured-logging adapter (core.telemetry)
+# stamps run_id/trace_id onto exactly this namespace.
+_logging.getLogger(__name__).addHandler(_logging.NullHandler())
 
 # Grown as subsystems land; every name here must resolve (tested).
 _LAZY_EXPORTS = {
@@ -39,6 +51,10 @@ _LAZY_EXPORTS = {
     "KerasImageFileTransformer": ("sparkdl_tpu.ml", "KerasImageFileTransformer"),
     "KerasImageFileEstimator": ("sparkdl_tpu.ml", "KerasImageFileEstimator"),
     "KerasTransformer": ("sparkdl_tpu.ml", "KerasTransformer"),
+    # observability surface (docs/OBSERVABILITY.md)
+    "Telemetry": ("sparkdl_tpu.core", "Telemetry"),
+    "telemetry": ("sparkdl_tpu.core", "telemetry"),
+    "HealthMonitor": ("sparkdl_tpu.core", "HealthMonitor"),
     # training surface
     "Trainer": ("sparkdl_tpu.train", "Trainer"),
     "TPURunner": ("sparkdl_tpu.train", "TPURunner"),

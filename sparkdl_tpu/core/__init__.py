@@ -14,10 +14,14 @@ from sparkdl_tpu.core import batching
 from sparkdl_tpu.core import health
 from sparkdl_tpu.core import pipeline
 from sparkdl_tpu.core import resilience
+from sparkdl_tpu.core import telemetry
 from sparkdl_tpu.core.pipeline import DevicePrefetcher
 from sparkdl_tpu.core.health import HealthMonitor
 from sparkdl_tpu.core.resilience import (
     Deadline, Fault, FaultInjector, RetryPolicy, classify,
+)
+from sparkdl_tpu.core.telemetry import (
+    MetricsRegistry, RunReport, Telemetry, Tracer,
 )
 
 __all__ = [
@@ -25,7 +29,8 @@ __all__ = [
     "MeshConfig", "make_mesh", "data_parallel_mesh", "batch_sharding",
     "replicated", "shard_batch",
     "ModelFunction", "InputModel", "TensorSpec",
-    "batching", "health", "pipeline", "resilience",
+    "batching", "health", "pipeline", "resilience", "telemetry",
     "Deadline", "DevicePrefetcher", "Fault", "FaultInjector",
-    "HealthMonitor", "RetryPolicy", "classify",
+    "HealthMonitor", "MetricsRegistry", "RetryPolicy", "RunReport",
+    "Telemetry", "Tracer", "classify",
 ]

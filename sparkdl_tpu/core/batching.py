@@ -15,7 +15,7 @@ from typing import Callable, Iterator, List, Optional, Tuple
 
 import numpy as np
 
-from sparkdl_tpu.core import health, resilience
+from sparkdl_tpu.core import health, resilience, telemetry
 
 logger = logging.getLogger(__name__)
 
@@ -162,6 +162,28 @@ def _dispatch_chunk(fn: Callable, chunk, n_valid: int,
         return out
 
 
+def _record_chunk_metrics(chunk, n_valid: int) -> None:
+    """Feed the active telemetry scope's bucket-occupancy / padding-waste
+    instruments (docs/OBSERVABILITY.md metric catalog). One global read
+    when no scope is active."""
+    tel = telemetry.active()
+    if tel is None:
+        return
+    import jax
+
+    bucket = jax.tree_util.tree_leaves(chunk)[0].shape[0]
+    valid = tel.metrics.counter(telemetry.M_BATCH_ROWS)
+    pad = tel.metrics.counter(telemetry.M_BATCH_PAD_ROWS)
+    valid.inc(n_valid)
+    pad.inc(bucket - n_valid)
+    tel.metrics.histogram(telemetry.M_BATCH_BUCKET_ROWS,
+                          telemetry.POW2_BOUNDS).observe(bucket)
+    total = valid.value + pad.value
+    if total:
+        tel.metrics.gauge(telemetry.M_PADDING_WASTE).set(
+            pad.value / total)
+
+
 def run_batched(fn: Callable, tree, batch_size: int,
                 multiple: int = 1,
                 retry_policy: Optional[resilience.RetryPolicy] = None,
@@ -209,6 +231,7 @@ def run_batched(fn: Callable, tree, batch_size: int,
             iter_batches_tree(tree, batch_size, multiple),
             depth=prefetch, name="run_batched") as staged:
         for chunk, n_valid in staged:
+            _record_chunk_metrics(chunk, n_valid)
             for out, v in _dispatch_chunk(fn, chunk, n_valid, multiple,
                                           policy):
                 outs.append(out)

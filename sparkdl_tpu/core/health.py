@@ -24,6 +24,8 @@ import logging
 import threading
 from typing import Any, Dict, List, Optional
 
+from sparkdl_tpu.core import telemetry
+
 logger = logging.getLogger(__name__)
 
 # Canonical event names fed by the framework's own layers. Callers may
@@ -70,12 +72,15 @@ class HealthMonitor:
         self._events: List[Dict[str, Any]] = []
         self._max_events = max_events
         self._dropped_events = 0
+        self._dropped_by_event: Dict[str, int] = {}
         self._prev: Optional["HealthMonitor"] = None
 
     # -- recording -----------------------------------------------------------
 
     def record(self, event: str, n: int = 1, **ctx: Any) -> None:
-        """Count ``event`` (``n`` occurrences) and log one context entry."""
+        """Count ``event`` (``n`` occurrences) and log one context entry.
+        Overflow past ``max_events`` is never silent: the drop is counted
+        (total and per event name) and surfaced in :meth:`report`."""
         with self._lock:
             self._counters[event] = self._counters.get(event, 0) + n
             if len(self._events) < self._max_events:
@@ -86,12 +91,19 @@ class HealthMonitor:
                 self._events.append(entry)
             else:
                 self._dropped_events += 1
+                self._dropped_by_event[event] = \
+                    self._dropped_by_event.get(event, 0) + 1
 
     # -- querying ------------------------------------------------------------
 
     def count(self, event: str) -> int:
         with self._lock:
             return self._counters.get(event, 0)
+
+    def dropped_events(self) -> int:
+        """Events the bounded log overflowed (counters stay exact)."""
+        with self._lock:
+            return self._dropped_events
 
     def counters(self) -> Dict[str, int]:
         with self._lock:
@@ -117,6 +129,8 @@ class HealthMonitor:
                                 if e["event"] == TASK_QUARANTINED],
                 "events_recorded": len(self._events),
                 "events_dropped": self._dropped_events,
+                "events_dropped_by_event": dict(
+                    sorted(self._dropped_by_event.items())),
             }
 
     def log_report(self, level: int = logging.INFO) -> None:
@@ -162,10 +176,15 @@ def active_monitor() -> Optional[HealthMonitor]:
 
 def record(event: str, n: int = 1, **ctx: Any) -> None:
     """Record into the active monitor (no-op — one global read — without
-    one)."""
+    one). Every record is also mirrored into the active telemetry
+    scope's metrics registry as the counter
+    ``sparkdl.health.<event>`` — one choke point, so the run report's
+    metric snapshot and the HealthMonitor counts agree exactly."""
     mon = _active
     if mon is not None:
         mon.record(event, n=n, **ctx)
+    if telemetry.active() is not None:
+        telemetry.count(telemetry.HEALTH_METRIC_PREFIX + event, n)
 
 
 def log_report(level: int = logging.INFO) -> None:

@@ -44,7 +44,7 @@ import time
 from dataclasses import dataclass
 from typing import Any, Callable, Iterable, Iterator, Optional
 
-from sparkdl_tpu.core import health, profiling
+from sparkdl_tpu.core import health, profiling, telemetry
 
 
 class _Done:
@@ -135,6 +135,11 @@ class DevicePrefetcher:
         self._queue: Optional[queue.Queue] = None
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # Cross-thread trace handoff (core.telemetry): spans opened on
+        # the staging thread (stage_fn's annotate calls, the source's
+        # decode phases) parent under the CONSUMER's span that built
+        # this prefetcher, keeping one run trace across threads.
+        self._trace_ctx = telemetry.current_context()
         if depth == 0:
             self._inline = iter(source)
             return
@@ -148,6 +153,7 @@ class DevicePrefetcher:
 
     def _produce(self, it: Iterator[Any]) -> None:
         out: Any = _Done
+        telemetry.attach(self._trace_ctx)  # fresh thread: safe to adopt
         try:
             while not self._stop.is_set():
                 t0 = time.perf_counter()
@@ -163,6 +169,9 @@ class DevicePrefetcher:
                     self.stats.stage_s += dt
                     if self._queue.qsize() + 1 > self.stats.max_depth:
                         self.stats.max_depth = self._queue.qsize() + 1
+                if telemetry.active() is not None:
+                    telemetry.gauge_set(telemetry.M_PREFETCH_DEPTH,
+                                        self._queue.qsize() + 1)
                 if not self._put(item):
                     return  # closed while waiting for queue room
         except BaseException as e:  # noqa: BLE001 - delivered to consumer
@@ -208,6 +217,7 @@ class DevicePrefetcher:
                 self.stats.stall_s += dt
                 self.stats.stage_s += dt
             profiling.add_phase_time(profiling.HOST_WAIT, dt)
+            telemetry.observe(telemetry.M_PREFETCH_STALL_S, dt)
             return staged
         if self._closed:
             raise StopIteration
@@ -224,6 +234,7 @@ class DevicePrefetcher:
                 self.stats.stalls += 1
                 self.stats.stall_s += dt
             profiling.add_phase_time(profiling.HOST_WAIT, dt)
+            telemetry.observe(telemetry.M_PREFETCH_STALL_S, dt)
         if item is _Done:
             self._finish()
             raise StopIteration

@@ -30,7 +30,9 @@ import contextlib
 import os
 import threading
 import time
-from typing import Dict, Iterator, Optional
+from typing import Any, Dict, Iterator, Optional
+
+from sparkdl_tpu.core import telemetry
 
 _lock = threading.Lock()
 _phase_totals: Dict[str, float] = {}
@@ -60,13 +62,18 @@ HOST_ETL_PHASES = ("sparkdl.decode", "sparkdl.stage", STAGE_BATCH,
 
 
 @contextlib.contextmanager
-def annotate(name: str) -> Iterator[None]:
-    """Named span: feeds phase timers and any active profiler trace."""
+def annotate(name: str, **attributes: Any) -> Iterator[None]:
+    """Named span: feeds phase timers, any active profiler trace, and —
+    when a ``core.telemetry`` scope is active — the telemetry tracer
+    (ambient-parented, so existing phase names become correlated spans
+    for free). ``attributes`` ride on the telemetry span only; the
+    phase timers stay name-keyed aggregates."""
     import jax.profiler
 
     t0 = time.perf_counter()
-    with jax.profiler.TraceAnnotation(name):
-        yield
+    with telemetry.span(name, **attributes):
+        with jax.profiler.TraceAnnotation(name):
+            yield
     dt = time.perf_counter() - t0
     with _lock:
         _phase_totals[name] = _phase_totals.get(name, 0.0) + dt

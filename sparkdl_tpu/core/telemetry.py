@@ -1,0 +1,760 @@
+"""Unified telemetry: cross-thread span tracing, metrics, one run report.
+
+The reference library shipped no in-tree observability — operators
+hand-instrumented the Spark UI and TF timelines (SURVEY.md §5.1). The
+rebuild had fragments: global phase accumulators (`core/profiling.py`),
+resilience counters (`core/health.py`), train metrics
+(`train/metrics.py`) — none sharing identifiers, none exportable
+together. With the data plane spanning four concurrent execution
+contexts (driver, supervisor pool threads, the `DevicePrefetcher`
+staging thread, the deferred-sync train loop), "where did step 412's
+batch spend its time, and which partition task stalled it?" needs
+correlated per-span records, not aggregate totals. This module is the
+Dapper-style span model plus the Prometheus metric taxonomy for exactly
+that, in three integrated parts:
+
+1. **Span tracing** — a :class:`Tracer` producing per-span records
+   (name, trace_id, span_id, parent_id, thread, start/end ns,
+   attributes) into a bounded ring buffer, with explicit cross-thread
+   context handoff (:func:`current_context` on the parent thread,
+   ``span(parent=ctx)`` or :func:`attach` on the child) so engine
+   partition tasks, prefetcher staging and `Trainer.fit` steps all
+   parent correctly under one run trace. Exportable as Chrome-trace
+   JSON (``chrome://tracing`` / Perfetto, one track per thread) with no
+   ``jax.profiler`` dependency.
+2. **Metrics registry** — named :class:`Counter` / :class:`Gauge` /
+   :class:`Histogram` instruments (fixed log-scale buckets with
+   p50/p95/p99 estimates), with a JSON :meth:`MetricsRegistry.snapshot`
+   and a Prometheus text-exposition dump.
+3. **Run report** — :class:`RunReport` merges the trace summary, the
+   metric snapshot, ``profiling.phase_stats()``/``overlap_stats()`` and
+   the active ``HealthMonitor`` report into one JSON artifact written
+   at scope exit (opt-in via ``SPARKDL_TELEMETRY_DIR`` or an explicit
+   ``Telemetry(out_dir=...)`` scope), plus a structured-logging adapter
+   stamping ``run_id``/``trace_id`` onto framework log records.
+
+Scoping mirrors :class:`~sparkdl_tpu.core.health.HealthMonitor`:
+a :class:`Telemetry` scope activates process-wide (engine partition ops
+run on pool threads where a ContextVar entered on the driver would be
+invisible), nests, and restores the previous scope on exit. With no
+active scope every entry point — :func:`span`, :func:`count`,
+:func:`gauge_set`, :func:`observe` — is a single global read + ``None``
+check returning a shared singleton: the hot paths allocate nothing and
+never touch a device (telemetry must never introduce a device sync; the
+step-loop AST lint in ``tests/test_taxonomy_lint.py`` stays satisfied).
+
+Dependency-free by design (stdlib only): every layer may import it
+without cycles. ``core.profiling`` imports this module; the run report
+imports ``profiling``/``health`` lazily to break the cycle.
+"""
+
+from __future__ import annotations
+
+import bisect
+import itertools
+import json
+import logging
+import math
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+TELEMETRY_DIR_ENV = "SPARKDL_TELEMETRY_DIR"
+
+# ---------------------------------------------------------------------------
+# Canonical names (docs/OBSERVABILITY.md is the human-readable catalog).
+# The taxonomy lint (tests/test_taxonomy_lint.py) checks every annotate()/
+# span() name used in sparkdl_tpu/ against CANONICAL_SPAN_NAMES — a typo'd
+# phase name would otherwise silently fork a timer.
+# ---------------------------------------------------------------------------
+
+SPAN_RUN = "sparkdl.run"                      # telemetry scope root
+SPAN_RUNNER_ATTEMPT = "sparkdl.runner_attempt"  # TPURunner gang attempt
+SPAN_FIT = "sparkdl.fit"                      # one Trainer.fit call
+SPAN_EPOCH = "sparkdl.epoch"                  # one epoch of the fit loop
+SPAN_CHECKPOINT_SAVE = "sparkdl.checkpoint_save"
+SPAN_ESTIMATOR_FIT = "sparkdl.estimator_fit"  # KerasImageFileEstimator._fit
+SPAN_COLLECT = "sparkdl.collect"              # estimator collected decode
+SPAN_MATERIALIZE = "sparkdl.materialize"      # DataFrame._materialize barrier
+SPAN_TASK = "sparkdl.task"                    # one pool attempt (or hedge)
+SPAN_TASK_ATTEMPT = "sparkdl.task_attempt"    # one retry-loop attempt
+
+CANONICAL_SPAN_NAMES = frozenset({
+    SPAN_RUN, SPAN_RUNNER_ATTEMPT, SPAN_FIT, SPAN_EPOCH,
+    SPAN_CHECKPOINT_SAVE, SPAN_ESTIMATOR_FIT, SPAN_COLLECT,
+    SPAN_MATERIALIZE, SPAN_TASK, SPAN_TASK_ATTEMPT,
+    # phase names (core/profiling.py constants + literal call sites)
+    "sparkdl.decode", "sparkdl.stage", "sparkdl.stage_batch",
+    "sparkdl.host_stage", "sparkdl.host_resize", "sparkdl.host_wait",
+    "sparkdl.device_apply", "sparkdl.train_step", "sparkdl.device_sync",
+})
+
+# Metric catalog. Histograms in seconds use DEFAULT_TIME_BOUNDS; row-count
+# histograms use POW2_BOUNDS. Health-event mirrors are dynamic:
+# "sparkdl.health.<event>" per core/health.py event name, bumped in
+# health.record so telemetry counters equal HealthMonitor counts exactly.
+M_TASK_DURATION_S = "sparkdl.task.duration_s"          # histogram
+M_STEP_TIME_S = "sparkdl.train.step_time_s"            # histogram (host)
+M_STEPS_PER_SEC = "sparkdl.train.steps_per_sec"        # histogram
+M_EXAMPLES_PER_SEC = "sparkdl.train.examples_per_sec"  # gauge
+M_PREFETCH_DEPTH = "sparkdl.prefetch.queue_depth"      # gauge
+M_PREFETCH_STALL_S = "sparkdl.prefetch.stall_s"        # histogram
+M_BATCH_ROWS = "sparkdl.batching.rows"                 # counter (valid rows)
+M_BATCH_PAD_ROWS = "sparkdl.batching.pad_rows"         # counter (pad rows)
+M_BATCH_BUCKET_ROWS = "sparkdl.batching.bucket_rows"   # histogram
+M_PADDING_WASTE = "sparkdl.batching.padding_waste"     # gauge (pad fraction)
+M_ENGINE_ROWS_OUT = "sparkdl.engine.rows_out"          # counter
+M_ENGINE_BYTES_OUT = "sparkdl.engine.bytes_out"        # counter
+HEALTH_METRIC_PREFIX = "sparkdl.health."
+
+CANONICAL_METRIC_NAMES = frozenset({
+    M_TASK_DURATION_S, M_STEP_TIME_S, M_STEPS_PER_SEC, M_EXAMPLES_PER_SEC,
+    M_PREFETCH_DEPTH, M_PREFETCH_STALL_S, M_BATCH_ROWS, M_BATCH_PAD_ROWS,
+    M_BATCH_BUCKET_ROWS, M_PADDING_WASTE, M_ENGINE_ROWS_OUT,
+    M_ENGINE_BYTES_OUT,
+})
+
+# ---------------------------------------------------------------------------
+# Span tracing
+# ---------------------------------------------------------------------------
+
+
+class SpanContext(NamedTuple):
+    """The cross-thread handoff token: enough to parent a remote span."""
+
+    trace_id: str
+    span_id: int
+
+
+class _RootSentinel:
+    """``Tracer.span(parent=ROOT)``: force a parentless root span (vs
+    ``parent=None``, which adopts the ambient context)."""
+
+
+ROOT = _RootSentinel()
+
+
+_tls = threading.local()
+
+
+def _span_stack() -> List["_Span"]:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+class _NullSpan:
+    """Shared no-op span: the inactive path returns THIS singleton —
+    zero allocation, inert context manager."""
+
+    __slots__ = ()
+    context: Optional[SpanContext] = None
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        return False
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        pass
+
+
+NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    """An open span; records into its tracer's ring buffer on exit."""
+
+    __slots__ = ("_tracer", "name", "trace_id", "span_id", "parent_id",
+                 "attributes", "_start_ns", "_pushed")
+
+    def __init__(self, tracer: "Tracer", name: str, trace_id: str,
+                 span_id: int, parent_id: Optional[int],
+                 attributes: Dict[str, Any]) -> None:
+        self._tracer = tracer
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.attributes = attributes
+        self._start_ns = 0
+        self._pushed = False
+
+    @property
+    def context(self) -> SpanContext:
+        return SpanContext(self.trace_id, self.span_id)
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        self.attributes[key] = value
+
+    def __enter__(self) -> "_Span":
+        _span_stack().append(self)
+        self._pushed = True
+        self._start_ns = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        end_ns = time.perf_counter_ns()
+        if self._pushed:
+            stack = _span_stack()
+            if stack and stack[-1] is self:
+                stack.pop()
+            elif self in stack:  # defensive: exited out of order
+                stack.remove(self)
+            self._pushed = False
+        if exc_type is not None:
+            self.attributes.setdefault("error", exc_type.__name__)
+        self._tracer._record(self, self._start_ns, end_ns)
+        return False
+
+
+class Tracer:
+    """Per-run span recorder: bounded ring buffer + Chrome-trace export.
+
+    The ring keeps the most recent ``max_spans`` finished spans (the
+    HealthMonitor event log keeps the FIRST n — traces want the tail: the
+    end of a run is where failures live) and counts evictions in
+    :attr:`dropped`. Thread-safe; spans may finish on any thread.
+    """
+
+    def __init__(self, trace_id: str, max_spans: int = 65536) -> None:
+        self.trace_id = trace_id
+        self.max_spans = max_spans
+        self.dropped = 0
+        self._lock = threading.Lock()
+        self._spans: "deque[Dict[str, Any]]" = deque(maxlen=max_spans)
+        self._ids = itertools.count(1)
+        self._t0_ns = time.perf_counter_ns()
+
+    # -- producing -----------------------------------------------------------
+
+    def span(self, name: str, parent: Any = None,
+             **attributes: Any) -> _Span:
+        """An open span context manager. ``parent`` explicitly parents a
+        cross-thread span (pass the creating thread's
+        :func:`current_context`); otherwise the ambient context — this
+        thread's innermost open span, its attached base, or the scope
+        root — is the parent. ``parent=ROOT`` makes a parentless root
+        span (the scope's own run span)."""
+        if parent is ROOT:
+            trace_id, parent_id = self.trace_id, None
+        else:
+            if parent is None:
+                parent = current_context()
+            if parent is None:
+                trace_id, parent_id = self.trace_id, None
+            else:
+                trace_id, parent_id = parent.trace_id, parent.span_id
+        return _Span(self, name, trace_id, next(self._ids), parent_id,
+                     attributes)
+
+    def _record(self, span: _Span, start_ns: int, end_ns: int) -> None:
+        thread = threading.current_thread()
+        rec = {
+            "name": span.name,
+            "trace_id": span.trace_id,
+            "span_id": span.span_id,
+            "parent_id": span.parent_id,
+            "thread_id": thread.ident,
+            "thread_name": thread.name,
+            "start_ns": start_ns - self._t0_ns,
+            "end_ns": end_ns - self._t0_ns,
+        }
+        if span.attributes:
+            rec["attributes"] = span.attributes
+        with self._lock:
+            if len(self._spans) == self.max_spans:
+                self.dropped += 1
+            self._spans.append(rec)
+
+    # -- querying / export ---------------------------------------------------
+
+    def spans(self, name: Optional[str] = None) -> List[Dict[str, Any]]:
+        with self._lock:
+            out = list(self._spans)
+        if name is not None:
+            out = [s for s in out if s["name"] == name]
+        return out
+
+    def summary(self) -> Dict[str, Any]:
+        """Aggregate per-name stats over ONE snapshot of the ring (the
+        count and the aggregates must agree even while other threads
+        keep recording)."""
+        spans = self.spans()
+        by_name: Dict[str, Dict[str, Any]] = {}
+        threads = set()
+        for s in spans:
+            threads.add((s["thread_id"], s["thread_name"]))
+            agg = by_name.setdefault(
+                s["name"], {"count": 0, "total_s": 0.0})
+            agg["count"] += 1
+            agg["total_s"] += (s["end_ns"] - s["start_ns"]) / 1e9
+        for agg in by_name.values():
+            agg["total_s"] = round(agg["total_s"], 6)
+            agg["mean_s"] = round(agg["total_s"] / agg["count"], 6)
+        return {
+            "trace_id": self.trace_id,
+            "spans_recorded": len(spans),
+            "spans_dropped": self.dropped,
+            "threads": sorted(t[1] for t in threads),
+            "by_name": {k: by_name[k] for k in sorted(by_name)},
+        }
+
+    def chrome_trace(self) -> Dict[str, Any]:
+        """Chrome-trace (Trace Event Format) document: complete ("X")
+        events in microseconds on one track per thread, loadable by
+        ``chrome://tracing`` and Perfetto. Timestamps are monotonic
+        (``perf_counter_ns`` rebased to the tracer epoch), so parent
+        spans always enclose their children."""
+        events: List[Dict[str, Any]] = []
+        pid = os.getpid()
+        seen_threads: Dict[int, str] = {}
+        for s in self.spans():
+            seen_threads.setdefault(s["thread_id"], s["thread_name"])
+            event = {
+                "name": s["name"], "cat": "sparkdl", "ph": "X",
+                "ts": s["start_ns"] / 1e3,
+                "dur": (s["end_ns"] - s["start_ns"]) / 1e3,
+                "pid": pid, "tid": s["thread_id"],
+                "args": {"trace_id": s["trace_id"],
+                         "span_id": s["span_id"],
+                         "parent_id": s["parent_id"],
+                         **s.get("attributes", {})},
+            }
+            events.append(event)
+        for tid, tname in seen_threads.items():
+            events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                           "tid": tid, "args": {"name": tname}})
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+# ---------------------------------------------------------------------------
+# Metrics registry
+# ---------------------------------------------------------------------------
+
+# Log-scale (factor-2) bucket upper bounds. Durations: 100 µs .. ~3.7 h.
+DEFAULT_TIME_BOUNDS: Tuple[float, ...] = tuple(
+    1e-4 * 2 ** i for i in range(27))
+# Row counts / sizes: powers of two 1 .. 64Ki.
+POW2_BOUNDS: Tuple[float, ...] = tuple(float(2 ** i) for i in range(17))
+
+
+class Counter:
+    """Monotonic counter."""
+
+    __slots__ = ("name", "_lock", "_value")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Last-write-wins instantaneous value."""
+
+    __slots__ = ("name", "_lock", "_value")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._lock = threading.Lock()
+        self._value: Optional[float] = None
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    @property
+    def value(self) -> Optional[float]:
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Fixed log-scale-bucket histogram with percentile estimates.
+
+    Buckets are upper bounds (Prometheus ``le`` semantics) growing by a
+    constant factor (default 2×), so the relative error of a percentile
+    estimate is bounded by the factor. p50/p95/p99 are estimated at the
+    geometric midpoint of the covering bucket, clamped to the observed
+    [min, max].
+    """
+
+    __slots__ = ("name", "_lock", "bounds", "_counts", "count", "sum",
+                 "min", "max")
+
+    def __init__(self, name: str,
+                 bounds: Sequence[float] = DEFAULT_TIME_BOUNDS) -> None:
+        self.name = name
+        self._lock = threading.Lock()
+        self.bounds = tuple(float(b) for b in bounds)
+        self._counts = [0] * (len(self.bounds) + 1)  # last = +Inf overflow
+        self.count = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        idx = bisect.bisect_left(self.bounds, value)
+        with self._lock:
+            self._counts[idx] += 1
+            self.count += 1
+            self.sum += value
+            if self.min is None or value < self.min:
+                self.min = value
+            if self.max is None or value > self.max:
+                self.max = value
+
+    def percentile(self, q: float) -> Optional[float]:
+        """Estimated q-quantile (q in [0, 1]) from the bucket counts."""
+        with self._lock:
+            if self.count == 0:
+                return None
+            target = max(1, math.ceil(q * self.count))
+            cum = 0
+            for i, c in enumerate(self._counts):
+                cum += c
+                if cum >= target:
+                    lo = self.bounds[i - 1] if i > 0 else 0.0
+                    hi = (self.bounds[i] if i < len(self.bounds)
+                          else (self.max if self.max is not None else lo))
+                    if lo > 0 and hi > 0:
+                        est = math.sqrt(lo * hi)
+                    else:
+                        est = hi
+                    return min(max(est, self.min), self.max)
+            return self.max
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            counts = list(self._counts)
+            count, total = self.count, self.sum
+            lo, hi = self.min, self.max
+        buckets = {("+Inf" if i == len(self.bounds)
+                    else repr(self.bounds[i])): c
+                   for i, c in enumerate(counts) if c}
+        return {
+            "count": count, "sum": round(total, 9), "min": lo, "max": hi,
+            "p50": self.percentile(0.50), "p95": self.percentile(0.95),
+            "p99": self.percentile(0.99), "buckets": buckets,
+        }
+
+
+class MetricsRegistry:
+    """Get-or-create registry of named instruments (one per name)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            inst = self._counters.get(name)
+            if inst is None:
+                inst = self._counters[name] = Counter(name)
+            return inst
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            inst = self._gauges.get(name)
+            if inst is None:
+                inst = self._gauges[name] = Gauge(name)
+            return inst
+
+    def histogram(self, name: str,
+                  bounds: Sequence[float] = DEFAULT_TIME_BOUNDS
+                  ) -> Histogram:
+        with self._lock:
+            inst = self._histograms.get(name)
+            if inst is None:
+                inst = self._histograms[name] = Histogram(name, bounds)
+            return inst
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-able {counters, gauges, histograms} snapshot."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            histograms = dict(self._histograms)
+        return {
+            "counters": {k: counters[k].value for k in sorted(counters)},
+            "gauges": {k: gauges[k].value for k in sorted(gauges)},
+            "histograms": {k: histograms[k].snapshot()
+                           for k in sorted(histograms)},
+        }
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition (0.0.4) dump of every instrument."""
+        import re as _re
+
+        def sane(name: str) -> str:
+            return _re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+
+        lines: List[str] = []
+        snap = self.snapshot()
+        for name, value in snap["counters"].items():
+            n = sane(name)
+            lines += [f"# TYPE {n} counter", f"{n} {value}"]
+        for name, value in snap["gauges"].items():
+            if value is None:
+                continue
+            n = sane(name)
+            lines += [f"# TYPE {n} gauge", f"{n} {value}"]
+        with self._lock:
+            hists = dict(self._histograms)
+        for name in sorted(hists):
+            h = hists[name]
+            n = sane(name)
+            lines.append(f"# TYPE {n} histogram")
+            with h._lock:
+                counts = list(h._counts)
+                count, total = h.count, h.sum
+            cum = 0
+            for i, bound in enumerate(h.bounds):
+                cum += counts[i]
+                lines.append(f'{n}_bucket{{le="{bound}"}} {cum}')
+            lines.append(f'{n}_bucket{{le="+Inf"}} {count}')
+            lines.append(f"{n}_sum {total}")
+            lines.append(f"{n}_count {count}")
+        return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# The process-wide scope
+# ---------------------------------------------------------------------------
+
+_run_counter = itertools.count(1)
+
+
+class _RunContextFilter(logging.Filter):
+    """Stamps run_id/trace_id onto log records (via the record factory,
+    so it reaches records regardless of which handler formats them)."""
+
+    def __init__(self, run_id: str, trace_id: str) -> None:
+        super().__init__()
+        self.run_id = run_id
+        self.trace_id = trace_id
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        record.run_id = self.run_id
+        record.trace_id = self.trace_id
+        return True
+
+
+class Telemetry:
+    """One run's telemetry scope: tracer + metrics + end-of-run report.
+
+    ::
+
+        with Telemetry("nightly-fit", out_dir="/tmp/tel") as tel:
+            pipeline.run()
+        # exiting wrote sparkdl_run_report_<run_id>.json and
+        # sparkdl_trace_<run_id>.json into out_dir
+
+    ``out_dir`` defaults to ``$SPARKDL_TELEMETRY_DIR``; when neither is
+    set no files are written and the scope is purely programmatic
+    (``tel.tracer`` / ``tel.metrics`` / ``tel.report()``). While the
+    scope is active, log records from the ``sparkdl_tpu`` namespace
+    carry ``.run_id`` / ``.trace_id`` attributes (structured-logging
+    adapter). To fold the active ``HealthMonitor``'s report into the
+    run report, enter the monitor BEFORE (outside) the telemetry scope.
+    """
+
+    def __init__(self, name: str = "run", out_dir: Optional[str] = None,
+                 max_spans: int = 65536) -> None:
+        self.name = name
+        self.out_dir = (out_dir if out_dir is not None
+                        else os.environ.get(TELEMETRY_DIR_ENV))
+        self.run_id = f"{name}-{os.getpid():x}-{next(_run_counter):04x}"
+        self.tracer = Tracer(trace_id=self.run_id, max_spans=max_spans)
+        self.metrics = MetricsRegistry()
+        self._prev: Optional["Telemetry"] = None
+        self._root: Optional[_Span] = None
+        self._prev_factory: Any = None
+        self._filter = _RunContextFilter(self.run_id, self.run_id)
+        self.report_path: Optional[str] = None
+        self.trace_path: Optional[str] = None
+
+    # -- context -------------------------------------------------------------
+
+    @property
+    def root_context(self) -> Optional[SpanContext]:
+        return self._root.context if self._root is not None else None
+
+    def __enter__(self) -> "Telemetry":
+        global _active
+        with _activation_lock:
+            self._prev = _active
+            _active = self
+            # structured-logging adapter: stamp run/trace ids at record
+            # creation so they survive any handler (a Filter on the
+            # package logger would miss records emitted via child
+            # loggers — logging only runs logger-level filters on the
+            # logger actually called)
+            prev_factory = logging.getLogRecordFactory()
+            self._prev_factory = prev_factory
+            flt = self._filter
+
+            def factory(*args: Any, **kwargs: Any) -> logging.LogRecord:
+                record = prev_factory(*args, **kwargs)
+                if record.name.startswith("sparkdl_tpu"):
+                    flt.filter(record)
+                return record
+
+            logging.setLogRecordFactory(factory)
+        self._root = self.tracer.span(SPAN_RUN, parent=ROOT,
+                                      run=self.name)
+        self._root.__enter__()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        global _active
+        if self._root is not None:
+            # pass the unwinding exception through so the run root span
+            # carries the error attribute like every interior span
+            exc3 = exc if len(exc) == 3 else (None, None, None)
+            self._root.__exit__(*exc3)
+        with _activation_lock:
+            _active = self._prev
+            self._prev = None
+            logging.setLogRecordFactory(self._prev_factory)
+        if self.out_dir:
+            try:
+                self.write_report(self.out_dir)
+            except OSError as e:
+                logging.getLogger(__name__).error(
+                    "could not write telemetry report to %r: %s",
+                    self.out_dir, e)
+
+    # -- reporting -----------------------------------------------------------
+
+    def report(self) -> Dict[str, Any]:
+        return RunReport.build(self)
+
+    def write_report(self, out_dir: str) -> str:
+        """Write the run report + Chrome trace JSONs; returns the report
+        path (also kept in :attr:`report_path` / :attr:`trace_path`)."""
+        os.makedirs(out_dir, exist_ok=True)
+        trace_path = os.path.join(
+            out_dir, f"sparkdl_trace_{self.run_id}.json")
+        with open(trace_path, "w") as f:
+            json.dump(self.tracer.chrome_trace(), f)
+        report = self.report()
+        report["chrome_trace"] = trace_path
+        report_path = os.path.join(
+            out_dir, f"sparkdl_run_report_{self.run_id}.json")
+        with open(report_path, "w") as f:
+            json.dump(report, f, indent=2, default=str)
+        self.report_path, self.trace_path = report_path, trace_path
+        return report_path
+
+
+_active: Optional[Telemetry] = None
+_activation_lock = threading.Lock()
+
+
+def active() -> Optional[Telemetry]:
+    return _active
+
+
+def current_context() -> Optional[SpanContext]:
+    """The ambient span context on THIS thread: innermost open span,
+    else the context attached via :func:`attach`, else the active
+    scope's root span. ``None`` without an active scope."""
+    tel = _active
+    if tel is None:
+        return None
+    stack = getattr(_tls, "stack", None)
+    if stack:
+        return stack[-1].context
+    base = getattr(_tls, "base", None)
+    if base is not None:
+        return base
+    return tel.root_context
+
+
+def attach(ctx: Optional[SpanContext]) -> None:
+    """Adopt ``ctx`` as this thread's base context: ambient spans opened
+    here parent under it. For FRESH worker threads (the prefetcher's
+    staging thread); pool threads that outlive a task should pass
+    ``parent=`` explicitly instead — an attached base would leak into
+    the next task."""
+    _tls.base = ctx
+
+
+def span(name: str, parent: Optional[SpanContext] = None,
+         **attributes: Any) -> Any:
+    """An open span on the active scope's tracer; the shared
+    :data:`NULL_SPAN` singleton (no allocation) when no scope is
+    active."""
+    tel = _active
+    if tel is None:
+        return NULL_SPAN
+    return tel.tracer.span(name, parent=parent, **attributes)
+
+
+def count(name: str, n: int = 1) -> None:
+    """Bump a counter on the active registry (no-op — one global read —
+    without a scope)."""
+    tel = _active
+    if tel is not None:
+        tel.metrics.counter(name).inc(n)
+
+
+def gauge_set(name: str, value: float) -> None:
+    tel = _active
+    if tel is not None:
+        tel.metrics.gauge(name).set(value)
+
+
+def observe(name: str, value: float,
+            bounds: Sequence[float] = DEFAULT_TIME_BOUNDS) -> None:
+    tel = _active
+    if tel is not None:
+        tel.metrics.histogram(name, bounds).observe(value)
+
+
+# ---------------------------------------------------------------------------
+# Run report
+# ---------------------------------------------------------------------------
+
+
+class RunReport:
+    """Builder for the single end-of-run JSON artifact: trace summary +
+    metric snapshot + phase/overlap stats + health report."""
+
+    @staticmethod
+    def build(tel: Telemetry,
+              health_monitor: Any = None) -> Dict[str, Any]:
+        # lazy imports: profiling imports this module at module level
+        from sparkdl_tpu.core import health as _health
+        from sparkdl_tpu.core import profiling as _profiling
+
+        mon = (health_monitor if health_monitor is not None
+               else _health.active_monitor())
+        return {
+            "run_id": tel.run_id,
+            "run": tel.name,
+            "created_unix_s": round(time.time(), 3),
+            "trace": tel.tracer.summary(),
+            "metrics": tel.metrics.snapshot(),
+            "phases": _profiling.phase_stats(),
+            "overlap": _profiling.overlap_stats(),
+            "health": mon.report() if mon is not None else None,
+        }

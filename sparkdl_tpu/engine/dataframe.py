@@ -34,7 +34,7 @@ import numpy as np
 import pandas as pd
 import pyarrow as pa
 
-from sparkdl_tpu.core import resilience
+from sparkdl_tpu.core import resilience, telemetry
 from sparkdl_tpu.engine import supervisor as _sup
 from sparkdl_tpu.engine.supervisor import (  # noqa: F401 - re-exported API
     PartitionSupervisor,
@@ -130,13 +130,21 @@ def _run_partition(index: int, batch: pa.RecordBatch,
     (FATAL never retried, OOM surfaced, RETRYABLE backed off; terminal
     TaskFailure carries the per-attempt history). ``cancelled`` is the
     supervisor watchdog's abandonment signal (None on inline paths)."""
-    return _sup.run_partition_task(
+    out = _sup.run_partition_task(
         index, batch, ops, policy=_task_policy(),
         deadline_s=EngineConfig.task_timeout_s,
         legacy_injector=EngineConfig.fault_injector,
         max_fatal_attempts=(EngineConfig.quarantine_max_fatal
                             if EngineConfig.quarantine else 1),
         cancelled=cancelled)
+    if cancelled is None and telemetry.active() is not None:
+        # inline (unsupervised) execution paths only — supervised tasks
+        # are counted once per WINNING attempt by the supervisor's
+        # resolve (a hedge loser running to completion must not
+        # double-count the partition's rows)
+        telemetry.count(telemetry.M_ENGINE_ROWS_OUT, out.num_rows)
+        telemetry.count(telemetry.M_ENGINE_BYTES_OUT, out.nbytes)
+    return out
 
 
 def _as_record_batches(table: pa.Table, num_partitions: int) -> List[pa.RecordBatch]:
@@ -265,10 +273,15 @@ class DataFrame:
             sup = PartitionSupervisor(_executor(), _supervisor_config(),
                                       quarantine_probe=self._quarantine_probe)
             ops = self._ops
-            self._materialized = sup.run_all(
-                [(i, lambda cancel, i=i, b=b: _run_partition(i, b, ops,
-                                                             cancel))
-                 for i, b in enumerate(self._partitions)])
+            # the span is open while tasks are CREATED, so every
+            # partition task's trace context parents under it
+            with telemetry.span(telemetry.SPAN_MATERIALIZE,
+                                partitions=len(self._partitions),
+                                ops=len(ops)):
+                self._materialized = sup.run_all(
+                    [(i, lambda cancel, i=i, b=b: _run_partition(i, b, ops,
+                                                                 cancel))
+                     for i, b in enumerate(self._partitions)])
             return self._materialized
 
     def toArrow(self) -> pa.Table:

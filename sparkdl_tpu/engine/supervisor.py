@@ -39,7 +39,7 @@ from dataclasses import dataclass
 from typing import (Any, Callable, Dict, Iterable, Iterator, List, Optional,
                     Sequence, Tuple)
 
-from sparkdl_tpu.core import health, resilience
+from sparkdl_tpu.core import health, resilience, telemetry
 
 logger = logging.getLogger(__name__)
 
@@ -152,23 +152,29 @@ def run_partition_task(index: int, batch: Any, ops: Sequence[Callable],
     while True:
         t0 = time.monotonic()
         try:
-            if legacy_injector is not None:
-                legacy_injector(index, attempt)
-            resilience.inject("engine_task", partition=index,
-                              attempt=attempt, phase="start")
-            _maybe_stall(index, attempt, deadline)
-            out = batch
-            for op in ops:
-                if abandoned():
-                    raise TaskFailure(
-                        f"partition {index} task abandoned by the "
-                        "supervisor", index=index, attempts=attempts,
-                        kind=resilience.FATAL, deadline=True)
-                deadline.check(f"partition {index} task")
-                out = op(out)
-            resilience.inject("engine_task", partition=index,
-                              attempt=attempt, phase="finish")
-            return out
+            # one telemetry span per retry-loop attempt (ambient-parented
+            # under the pool thread's sparkdl.task span, so a retried or
+            # hedged task's attempts all share the task's trace); an
+            # exception unwinding through it stamps an `error` attribute
+            with telemetry.span(telemetry.SPAN_TASK_ATTEMPT,
+                                partition=index, attempt=attempt):
+                if legacy_injector is not None:
+                    legacy_injector(index, attempt)
+                resilience.inject("engine_task", partition=index,
+                                  attempt=attempt, phase="start")
+                _maybe_stall(index, attempt, deadline)
+                out = batch
+                for op in ops:
+                    if abandoned():
+                        raise TaskFailure(
+                            f"partition {index} task abandoned by the "
+                            "supervisor", index=index, attempts=attempts,
+                            kind=resilience.FATAL, deadline=True)
+                    deadline.check(f"partition {index} task")
+                    out = op(out)
+                resilience.inject("engine_task", partition=index,
+                                  attempt=attempt, phase="finish")
+                return out
         except Exception as e:  # noqa: BLE001 - classified below
             if abandoned():
                 # The watchdog already failed this task, recorded the
@@ -288,7 +294,7 @@ class _Task:
 
     __slots__ = ("index", "runner", "_submit", "holders", "futures",
                  "hedged", "done", "result", "error", "duration",
-                 "deadline_failed", "cancel_event")
+                 "deadline_failed", "cancel_event", "trace_ctx")
 
     def __init__(self, index: int,
                  runner: Callable[[threading.Event], Any],
@@ -296,6 +302,11 @@ class _Task:
         self.index = index
         self.runner = runner
         self._submit = submit
+        # Captured on the SCHEDULING thread: every attempt of this task
+        # (primary, retries inside it, a hedge duplicate) opens its pool-
+        # thread span under this context, so they all share the task's
+        # trace (core.telemetry cross-thread handoff).
+        self.trace_ctx = telemetry.current_context()
         self.holders: List[Dict[str, float]] = []
         self.futures: List[_futures.Future] = []
         self.hedged = False
@@ -310,10 +321,17 @@ class _Task:
         holder: Dict[str, float] = {}
         runner = self.runner
         cancel_event = self.cancel_event
+        attempt = len(self.holders)  # 0 = primary, 1 = the hedge
+        ctx = self.trace_ctx
+        index = self.index
 
         def run(h=holder):
             h["started"] = time.monotonic()
-            return runner(cancel_event)
+            # explicit parent (NOT telemetry.attach): pool threads are
+            # reused, an attached base would leak into the next task
+            with telemetry.span(telemetry.SPAN_TASK, parent=ctx,
+                                partition=index, pool_attempt=attempt):
+                return runner(cancel_event)
 
         self.holders.append(holder)
         fut = self._submit(run)
@@ -452,6 +470,7 @@ class PartitionSupervisor:
             task.done = True
             task.duration = (time.monotonic() - started
                              if started is not None else 0.0)
+            telemetry.observe(telemetry.M_TASK_DURATION_S, task.duration)
             err = fut.exception()
             if err is not None:
                 # First terminal outcome wins, success or failure: the
@@ -468,6 +487,16 @@ class PartitionSupervisor:
             else:
                 task.result = fut.result()
                 self._durations.append(task.duration)
+                if telemetry.active() is not None:
+                    # rows/bytes of the WINNING attempt only (a hedge
+                    # loser's identical result is discarded above and
+                    # must not double-count the partition)
+                    num_rows = getattr(task.result, "num_rows", None)
+                    if num_rows is not None:
+                        telemetry.count(telemetry.M_ENGINE_ROWS_OUT,
+                                        num_rows)
+                        telemetry.count(telemetry.M_ENGINE_BYTES_OUT,
+                                        task.result.nbytes)
                 if task.hedged and fut is not task.futures[0]:
                     health.record(health.HEDGE_WON, partition=task.index)
                     logger.info("hedge won for partition %d", task.index)

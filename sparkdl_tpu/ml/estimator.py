@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from sparkdl_tpu.core import profiling
+from sparkdl_tpu.core import profiling, telemetry
 from sparkdl_tpu.core.model_function import ModelFunction
 from sparkdl_tpu.image import imageIO
 from sparkdl_tpu.ml.base import Estimator, Model
@@ -183,7 +183,8 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         """
         mf = self._model_function()
         loaded, target_size = self._loaded_frame(dataset)
-        rows = loaded.select(_LOADED_COL, self.getLabelCol()).collect()
+        with telemetry.span(telemetry.SPAN_COLLECT):
+            rows = loaded.select(_LOADED_COL, self.getLabelCol()).collect()
         structs = [r[_LOADED_COL] for r in rows]
         labels = [r[self.getLabelCol()] for r in rows]
         keep = [i for i, s in enumerate(structs) if s is not None]
@@ -472,10 +473,13 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         return self._wrap_trained(mf, state, history)
 
     def _fit(self, dataset) -> "KerasImageFileModel":
-        if bool(self.getKerasFitParams().get("streaming", True)):
-            return self._fit_streaming(dataset)
-        x, y = self._collect_arrays(dataset)
-        return self._fit_on_arrays(x, y)
+        streaming = bool(self.getKerasFitParams().get("streaming", True))
+        with telemetry.span(telemetry.SPAN_ESTIMATOR_FIT,
+                            streaming=streaming):
+            if streaming:
+                return self._fit_streaming(dataset)
+            x, y = self._collect_arrays(dataset)
+            return self._fit_on_arrays(x, y)
 
     # -- persistence (unfitted estimator; VERDICT r3 #6) ---------------------
 
@@ -628,7 +632,7 @@ class _PartitionBatchStream:
             yield nxt
 
     def _partition_arrays(self, part) -> Optional[Tuple[np.ndarray, np.ndarray]]:
-        with profiling.annotate("sparkdl.stage"):
+        with profiling.annotate("sparkdl.stage", rows=part.num_rows):
             return self._partition_arrays_inner(part)
 
     def _partition_arrays_inner(self, part

@@ -149,7 +149,8 @@ class TPUImageTransformer(Transformer, HasInputCol, HasOutputCol,
                 valid = valid_np.tolist()
                 stacked, run_fast = _resize_uniform_batch(stacked, target_size,
                                                           run)
-                with profiling.annotate("sparkdl.device_apply"):
+                with profiling.annotate("sparkdl.device_apply",
+                                        rows=len(stacked)):
                     out = run_fast.apply_batch(stacked, batch_size=batch_size,
                                                mesh=mesh,
                                                prefetch=_PREFETCH_DEPTH)
@@ -167,7 +168,8 @@ class TPUImageTransformer(Transformer, HasInputCol, HasOutputCol,
             # codes, injected decode_error faults) degrade to null output
             # cells instead of aborting the partition (Spark's
             # corrupt-image convention); the drop count is surfaced below.
-            with profiling.annotate("sparkdl.host_stage"):
+            with profiling.annotate("sparkdl.host_stage",
+                                    rows=len(present)):
                 stacked, kept, dropped = \
                     imageIO.imageStructsToBatchArrayTolerant(
                         [structs[i] for i in present],
@@ -182,7 +184,8 @@ class TPUImageTransformer(Transformer, HasInputCol, HasOutputCol,
                 out_type = (pa.list_(pa.float32()) if mode == "vector"
                             else imageIO.imageSchema)
                 return pa.array([None] * batch.num_rows, type=out_type)
-            with profiling.annotate("sparkdl.device_apply"):
+            with profiling.annotate("sparkdl.device_apply",
+                                    rows=len(stacked)):
                 out = run.apply_batch(stacked, batch_size=batch_size,
                                       mesh=mesh, prefetch=_PREFETCH_DEPTH)
             if mode == "vector":

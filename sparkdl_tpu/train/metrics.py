@@ -15,6 +15,8 @@ from typing import Any, Callable, Dict, List, Optional
 
 import jax
 
+from sparkdl_tpu.core import telemetry
+
 
 def hbm_stats(device=None) -> Dict[str, int]:
     """Bytes in use / limit for one device; {} where unsupported (CPU)."""
@@ -109,6 +111,11 @@ class MetricsLogger:
             dt = now - self._t_last
             if dt > 0:
                 rate = window_examples / dt
+                # telemetry (docs/OBSERVABILITY.md): the flush-window
+                # steady-state ingest rate (the steps/sec HISTOGRAM is
+                # fed by Trainer.fit's sync points, which exist even
+                # without a MetricsLogger)
+                telemetry.gauge_set(telemetry.M_EXAMPLES_PER_SEC, rate)
         self._t_last = now
         return [self._materialize(step, metrics,
                                   rate if examples is not None else None)

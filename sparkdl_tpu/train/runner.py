@@ -25,7 +25,7 @@ from typing import Any, Callable, Optional
 
 import jax
 
-from sparkdl_tpu.core import health, resilience
+from sparkdl_tpu.core import health, resilience, telemetry
 from sparkdl_tpu.core.mesh import MeshConfig, make_mesh
 
 logger = logging.getLogger(__name__)
@@ -110,7 +110,12 @@ class TPURunner:
         last_err: Optional[BaseException] = None
         for attempt in range(attempts):
             try:
-                return main(**call_kwargs)
+                # telemetry: one span per gang attempt — the fit span
+                # (and everything under it) nests here, so a restarted
+                # run's trace shows attempt 1 vs attempt 2 side by side
+                with telemetry.span(telemetry.SPAN_RUNNER_ATTEMPT,
+                                    attempt=attempt):
+                    return main(**call_kwargs)
             except Exception as e:  # noqa: BLE001 - gang boundary
                 kind = resilience.classify(e)
                 if kind != resilience.RETRYABLE:

@@ -16,6 +16,8 @@ backward, all-reduce and update in a single XLA program:
 from __future__ import annotations
 
 import math
+import sys
+import time
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Iterable, Optional, Tuple
 
@@ -25,7 +27,7 @@ import numpy as np
 import optax
 from flax import struct
 
-from sparkdl_tpu.core import health, pipeline, profiling, resilience
+from sparkdl_tpu.core import health, pipeline, profiling, resilience, telemetry
 from sparkdl_tpu.core.mesh import batch_sharding, replicated
 from sparkdl_tpu.train.checkpoint import CheckpointManager
 from sparkdl_tpu.train.metrics import MetricsLogger
@@ -476,6 +478,8 @@ class Trainer:
         host_step = done
         global_idx = 0
         sync_every = max(1, int(sync_every))
+        last_sync_t: Optional[float] = None
+        last_sync_step = done
 
         def sync(st: TrainState) -> None:
             """Designated sync point — the ONLY place the step loop blocks
@@ -484,7 +488,11 @@ class Trainer:
             batched fetch), then barriers on the device step counter — a
             scalar fetch, the reliable barrier under the remote tunnel
             (core/profiling.py; cross-dispatch block_until_ready is not).
+            The sync window also feeds the telemetry steps/sec histogram:
+            steps COMPLETED (barriered) per wall second, the honest
+            throughput number the deferred pipeline obscures per step.
             """
+            nonlocal last_sync_t, last_sync_step
             if metrics_logger is not None:
                 metrics_logger.flush()
             with profiling.annotate(profiling.DEVICE_SYNC):
@@ -495,9 +503,18 @@ class Trainer:
                     f"{device_step} != host-tracked step {host_step} — "
                     "the batch stream or state chain was tampered with "
                     "mid-fit")
+            now = time.perf_counter()
+            if last_sync_t is not None and host_step > last_sync_step:
+                dt = now - last_sync_t
+                if dt > 0:
+                    telemetry.observe(telemetry.M_STEPS_PER_SEC,
+                                      (host_step - last_sync_step) / dt)
+            last_sync_t, last_sync_step = now, host_step
 
         def save_checkpoint(st: TrainState) -> None:
-            checkpoint.save(host_step, jax.device_get(st))
+            with telemetry.span(telemetry.SPAN_CHECKPOINT_SAVE,
+                                step=host_step):
+                checkpoint.save(host_step, jax.device_get(st))
 
         def epoch_source():
             # runs on the staging thread: resume-skipped positions are
@@ -510,18 +527,37 @@ class Trainer:
                 global_idx += 1
                 yield pair
 
+        # Telemetry (docs/OBSERVABILITY.md): the fit span is the parent
+        # of every epoch/step span on this thread AND — via the
+        # prefetcher's context handoff — of the staging thread's
+        # stage_batch/decode spans, so one run trace covers both sides
+        # of the pipeline. Step timing below is HOST dispatch interval
+        # (perf_counter only — telemetry must never sync the device; the
+        # step-loop AST lint enforces it).
+        fit_span = telemetry.span(telemetry.SPAN_FIT, epochs=epochs,
+                                  resume_step=done, prefetch=prefetch,
+                                  sync_every=sync_every)
+        last_dispatch = None
         try:
+            fit_span.__enter__()
             for _epoch in range(epochs):
-                with pipeline.DevicePrefetcher(
+                with telemetry.span(telemetry.SPAN_EPOCH, epoch=_epoch), \
+                        pipeline.DevicePrefetcher(
                         epoch_source(), stage_fn=stage_pair,
                         depth=prefetch, name="trainer.fit",
                         report_health=True) as staged:
                     for n_examples, xd, yd in staged:
                         # dispatch only — execution is awaited at sync
                         # points (DEVICE_SYNC carries the blocking time)
-                        with profiling.annotate("sparkdl.train_step"):
+                        with profiling.annotate("sparkdl.train_step",
+                                                step=host_step + 1):
                             state, metrics = train_step(state, xd, yd)
                         host_step += 1
+                        now = time.perf_counter()
+                        if last_dispatch is not None:
+                            telemetry.observe(telemetry.M_STEP_TIME_S,
+                                              now - last_dispatch)
+                        last_dispatch = now
                         if metrics_logger is not None:
                             metrics_logger.log_step(host_step, metrics,
                                                     examples=n_examples,
@@ -566,11 +602,21 @@ class Trainer:
                     checkpoint.wait_until_finished()
                 except Exception:  # noqa: BLE001 - already unwinding
                     pass
+            fit_span.__exit__(*sys.exc_info())
             raise
-        if checkpoint is not None:
-            checkpoint.save(host_step, jax.device_get(state),
-                            synchronous=True)
-        health.record(health.FIT_COMPLETED, steps=host_step)
+        try:
+            if checkpoint is not None:
+                checkpoint.save(host_step, jax.device_get(state),
+                                synchronous=True)
+            health.record(health.FIT_COMPLETED, steps=host_step)
+            fit_span.set_attribute("steps", host_step)
+        except BaseException:
+            # the final synchronous save can fail too (disk full, bad
+            # path) — the span must still close, or it leaks on the
+            # thread-local stack and adopts every later span
+            fit_span.__exit__(*sys.exc_info())
+            raise
+        fit_span.__exit__(None, None, None)
         return state
 
     def variables_of(self, state: TrainState) -> Dict[str, Any]:

@@ -40,6 +40,26 @@ def test_event_log_bounded_counter_unbounded():
     assert rep["events_recorded"] == 3 and rep["events_dropped"] == 7
 
 
+def test_dropped_events_counted_per_event_and_queryable():
+    """Overflow is never silent (ISSUE 4 satellite): the total AND the
+    per-event-name breakdown surface in report(), plus an accessor."""
+    mon = HealthMonitor(max_events=2)
+    mon.record("task_retried", partition=0)
+    mon.record("task_retried", partition=1)
+    assert mon.dropped_events() == 0
+    for i in range(3):
+        mon.record("task_retried", partition=2 + i)
+    mon.record("oom_rechunk", bucket=8)
+    assert mon.dropped_events() == 4
+    rep = mon.report()
+    assert rep["events_dropped"] == 4
+    assert rep["events_dropped_by_event"] == {"task_retried": 3,
+                                              "oom_rechunk": 1}
+    # counters stay exact regardless of log overflow
+    assert mon.count("task_retried") == 5
+    assert mon.count("oom_rechunk") == 1
+
+
 def test_module_record_requires_active_monitor():
     health.record("task_started")  # no monitor: no-op, no error
     assert health.active_monitor() is None

@@ -8,6 +8,7 @@ the fault-free run, and the HealthMonitor report must match the injected
 fault counts exactly.
 """
 
+import json
 import re
 import time
 
@@ -18,8 +19,9 @@ import pytest
 import jax
 import flax.linen as nn
 
-from sparkdl_tpu.core import health, resilience
+from sparkdl_tpu.core import health, resilience, telemetry
 from sparkdl_tpu.core.health import HealthMonitor
+from sparkdl_tpu.core.telemetry import Telemetry
 from sparkdl_tpu.core.model_function import ModelFunction, TensorSpec
 from sparkdl_tpu.core.resilience import Fault, FaultInjector
 from sparkdl_tpu.engine import DataFrame, EngineConfig, TaskFailure
@@ -177,6 +179,85 @@ def test_chaos_pipeline_recovers_bit_identical(image_dir, tmp_path):
     assert mon.count(health.TASK_QUARANTINED) == 0
     assert mon.count(health.TASK_DEADLINE_EXCEEDED) == 0
     assert mon.count(health.GANG_FATAL) == 0
+
+
+def test_chaos_run_under_telemetry_scope_produces_run_report(image_dir,
+                                                             tmp_path):
+    """ISSUE 4 acceptance: the full chaos pipeline under an active
+    telemetry scope yields ONE RunReport JSON whose trace holds
+    correctly-parented spans from >= 3 distinct threads, whose metric
+    snapshot's retry/quarantine counters equal the HealthMonitor counts,
+    and whose Chrome-trace export loads as valid JSON — while outputs
+    stay bit-identical to the telemetry-off run."""
+    x0, y0, final0, steps0 = _run_pipeline(image_dir, tmp_path / "plain")
+
+    inj = FaultInjector.seeded(
+        0,
+        decode_error=1,
+        engine_task=Fault(times=1, when=lambda c: (
+            c.get("phase") == "finish" and c["attempt"] == 0)),
+        device_oom=Fault(times=1, when=lambda c: c["rows"] >= 8),
+        transfer_stall=1,
+        preemption=Fault(when=lambda c: c["step"] == 3),
+    )
+    tel_dir = tmp_path / "tel"
+    # monitor OUTSIDE the telemetry scope so the report (written at
+    # telemetry exit) folds the still-active monitor in
+    with inj, HealthMonitor("chaos-tel") as mon:
+        with Telemetry("chaos", out_dir=str(tel_dir)) as tel:
+            x1, y1, final1, steps1 = _run_pipeline(image_dir,
+                                                   tmp_path / "chaos")
+    assert sum(inj.fired.values()) == 5  # every fault actually fired
+
+    # outputs bit-identical to the telemetry-off run
+    np.testing.assert_array_equal(x1, x0)
+    np.testing.assert_array_equal(y1, y0)
+    assert steps1 == steps0
+    for a, b in zip(jax.tree.leaves(final0.params),
+                    jax.tree.leaves(final1.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+
+    # ONE run report, written at scope exit, valid JSON
+    reports = sorted(tel_dir.glob("sparkdl_run_report_*.json"))
+    assert len(reports) == 1
+    report = json.load(open(reports[0]))
+    assert report["run_id"] == tel.run_id
+
+    # trace: correctly-parented spans from >= 3 distinct threads
+    spans = tel.tracer.spans()
+    ids = {s["span_id"] for s in spans}
+    assert len({s["thread_id"] for s in spans}) >= 3
+    for s in spans:
+        assert s["trace_id"] == tel.run_id
+        if s["name"] != telemetry.SPAN_RUN:
+            assert s["parent_id"] in ids, s
+    names = {s["name"] for s in spans}
+    assert {"sparkdl.run", "sparkdl.materialize", "sparkdl.task",
+            "sparkdl.fit", "sparkdl.train_step",
+            "sparkdl.stage_batch"} <= names
+    # the report's summary agrees with the live tracer
+    assert report["trace"]["spans_recorded"] == len(spans)
+    assert len(report["trace"]["threads"]) >= 3
+
+    # metric snapshot counters equal the HealthMonitor counts
+    counters = report["metrics"]["counters"]
+    for event in (health.TASK_RETRIED, health.TASK_QUARANTINED,
+                  health.OOM_RECHUNK, health.CHUNK_RETRY,
+                  health.GANG_RESTART, health.DECODE_DEGRADED,
+                  health.FIT_RESUMED, health.FIT_COMPLETED):
+        assert counters.get(telemetry.HEALTH_METRIC_PREFIX + event, 0) \
+            == mon.count(event), event
+    assert counters["sparkdl.health.task_retried"] == 1
+    assert counters.get("sparkdl.health.task_quarantined", 0) == 0
+    assert report["health"]["counters"] == mon.report()["counters"]
+
+    # Chrome-trace export loads as valid JSON with per-thread tracks
+    trace = json.load(open(report["chrome_trace"]))
+    complete = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert len(complete) == len(spans)
+    assert len({e["tid"] for e in complete}) >= 3
+    assert all(e["ts"] >= 0 and e["dur"] >= 0 for e in complete)
 
 
 def test_chaos_fatal_transform_error_retried_zero_times(image_dir):

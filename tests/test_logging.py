@@ -1,0 +1,70 @@
+"""Library logging etiquette (ISSUE 4 satellite): a NullHandler on the
+``sparkdl_tpu`` root logger, and every module logger routed under that
+namespace — apps that configure logging see one coherent tree, apps that
+don't see zero output changes (and no "no handlers" warnings)."""
+
+import ast
+import logging
+import pathlib
+
+import sparkdl_tpu  # noqa: F401 - importing attaches the NullHandler
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent / "sparkdl_tpu"
+
+
+def test_root_logger_has_null_handler_and_nothing_else():
+    root = logging.getLogger("sparkdl_tpu")
+    assert any(isinstance(h, logging.NullHandler) for h in root.handlers)
+    # the library must not install real handlers (that's the app's job)
+    assert all(isinstance(h, logging.NullHandler) for h in root.handlers)
+    # and must not fiddle with propagation or levels
+    assert root.propagate
+    assert root.level == logging.NOTSET
+
+
+def test_every_module_logger_uses_dunder_name():
+    """AST scan: every getLogger call in the library passes __name__ (or
+    a dotted sparkdl_tpu.* literal), so all records flow under the
+    package namespace the NullHandler and the telemetry run-id stamp
+    cover."""
+    offenders = []
+    for path in sorted(ROOT.rglob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "getLogger"):
+                continue
+            if not node.args:  # bare getLogger(): the global root
+                offenders.append(f"{path.name}:{node.lineno}: root logger")
+                continue
+            arg = node.args[0]
+            ok = (isinstance(arg, ast.Name) and arg.id == "__name__") or (
+                isinstance(arg, ast.Constant)
+                and isinstance(arg.value, str)
+                and arg.value.startswith("sparkdl_tpu"))
+            if not ok:
+                offenders.append(
+                    f"{path.name}:{node.lineno}: "
+                    f"{ast.dump(arg)}")
+    assert not offenders, (
+        "module loggers must be namespaced under sparkdl_tpu "
+        f"(getLogger(__name__)): {offenders}")
+
+
+def test_unconfigured_logging_emits_nothing(capsys):
+    """A warning through a library logger with no app handlers configured
+    must not print (NullHandler swallows lastResort only when no handler
+    exists; here it guarantees no 'no handlers' complaints either)."""
+    logger = logging.getLogger("sparkdl_tpu.tests.silent")
+    # simulate an unconfigured app: no root handlers during the call
+    root_handlers, logging.root.handlers = logging.root.handlers, []
+    last_resort, logging.lastResort = logging.lastResort, None
+    try:
+        logger.warning("should be swallowed")
+    finally:
+        logging.root.handlers = root_handlers
+        logging.lastResort = last_resort
+    captured = capsys.readouterr()
+    assert "should be swallowed" not in captured.err
+    assert "No handlers could be found" not in captured.err

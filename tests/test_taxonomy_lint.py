@@ -211,3 +211,95 @@ def test_lint_catches_the_old_per_step_sync_shape():
         "        return state\n"
     )
     assert _blocking_calls_in_fit_loops(ast.parse(bad)) == [9, 10]
+
+
+# ---------------------------------------------------------------------------
+# Canonical span/phase name lint (ISSUE 4): every name passed to
+# profiling.annotate() or telemetry.span() in sparkdl_tpu/ must be declared
+# in core.telemetry.CANONICAL_SPAN_NAMES — a typo'd phase name would
+# otherwise silently fork a timer (and a trace track) instead of failing.
+# Names arriving as profiling/telemetry module constants resolve through
+# the live modules; dynamic names (the annotate/span wrappers forwarding a
+# parameter) are skipped — only literals and known constants are checkable.
+# ---------------------------------------------------------------------------
+
+from sparkdl_tpu.core import profiling as _profiling  # noqa: E402
+from sparkdl_tpu.core import telemetry as _telemetry  # noqa: E402
+
+_SPAN_CALL_NAMES = {"annotate", "span"}
+
+
+def _resolve_name_arg(arg: ast.expr):
+    """String value of a span-name argument, or None when dynamic."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    attr = None
+    if isinstance(arg, ast.Attribute):  # profiling.STAGE_BATCH
+        attr = arg.attr
+    elif isinstance(arg, ast.Name):     # SPAN_RUN inside telemetry.py
+        attr = arg.id
+    if attr is not None:
+        for mod in (_profiling, _telemetry):
+            value = getattr(mod, attr, None)
+            if isinstance(value, str):
+                return value
+    return None
+
+
+def _span_names_in(tree: ast.AST):
+    """(name, lineno) for every statically-resolvable annotate()/span()
+    call in the tree."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        f = node.func
+        fname = (f.id if isinstance(f, ast.Name)
+                 else f.attr if isinstance(f, ast.Attribute) else None)
+        if fname not in _SPAN_CALL_NAMES:
+            continue
+        name = _resolve_name_arg(node.args[0])
+        if name is not None:
+            out.append((name, node.lineno))
+    return out
+
+
+def test_every_span_name_is_canonical():
+    catalog = _telemetry.CANONICAL_SPAN_NAMES
+    offenders = []
+    for path in sorted(ROOT.rglob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for name, line in _span_names_in(tree):
+            if name not in catalog:
+                offenders.append(
+                    f"{path.relative_to(ROOT.parent)}:{line}: {name!r}")
+    assert not offenders, (
+        "span/phase name not declared in "
+        "core.telemetry.CANONICAL_SPAN_NAMES — a typo'd name silently "
+        "forks a timer and a trace track. Add the name to the catalog "
+        f"(and docs/OBSERVABILITY.md) or fix the typo: {offenders}")
+
+
+def test_span_name_lint_catches_typo_and_resolves_constants():
+    """Self-test: a typo'd literal trips the check; module-constant names
+    resolve to their canonical strings."""
+    bad = (
+        "from sparkdl_tpu.core import profiling, telemetry\n"
+        "with profiling.annotate('sparkdl.train_stepp'):\n"  # typo
+        "    pass\n"
+        "with telemetry.span(telemetry.SPAN_FIT):\n"         # constant
+        "    pass\n"
+        "with profiling.annotate(profiling.STAGE_BATCH):\n"  # constant
+        "    pass\n"
+        "with telemetry.span(dynamic_name):\n"               # skipped
+        "    pass\n"
+    )
+    names = _span_names_in(ast.parse(bad))
+    assert ("sparkdl.train_stepp", 2) in names
+    assert ("sparkdl.fit", 4) in names
+    assert ("sparkdl.stage_batch", 6) in names
+    assert len(names) == 3  # the dynamic name is not checkable
+    resolved = [n for n, _ in names]
+    assert "sparkdl.train_stepp" not in _telemetry.CANONICAL_SPAN_NAMES
+    assert all(n in _telemetry.CANONICAL_SPAN_NAMES
+               for n in resolved if n != "sparkdl.train_stepp")
