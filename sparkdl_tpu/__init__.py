@@ -26,6 +26,42 @@ from sparkdl_tpu.version import __version__
 # stamps run_id/trace_id onto exactly this namespace.
 _logging.getLogger(__name__).addHandler(_logging.NullHandler())
 
+# JAX persistent compilation cache (docs/PERF.md "Cross-partition
+# coalescing": the bucket ladder can compile a handful of programs per
+# model; a warm on-disk cache makes every process after the first
+# compile-free). Opt-in via SPARKDL_COMPILE_CACHE_DIR so the default
+# `import sparkdl_tpu` stays jax-import free and cheap.
+COMPILE_CACHE_DIR_ENV = "SPARKDL_COMPILE_CACHE_DIR"
+
+
+def _configure_compile_cache(cache_dir=None):
+    """Point JAX's persistent compilation cache at ``cache_dir`` (default:
+    ``$SPARKDL_COMPILE_CACHE_DIR``). Returns True when configured. The
+    thresholds are zeroed so even the small bucket-ladder programs are
+    cached; first-launch compiles are visible as ``sparkdl.compile``
+    spans in the telemetry run report either way."""
+    import os as _os
+
+    cache_dir = (cache_dir if cache_dir is not None
+                 else _os.environ.get(COMPILE_CACHE_DIR_ENV))
+    if not cache_dir:
+        return False
+    try:
+        import jax as _jax
+
+        _jax.config.update("jax_compilation_cache_dir", cache_dir)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        _jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception as e:  # pragma: no cover - jax version drift
+        _logging.getLogger(__name__).warning(
+            "could not enable the persistent compilation cache at %r: %s",
+            cache_dir, e)
+        return False
+    return True
+
+
+_configure_compile_cache()
+
 # Grown as subsystems land; every name here must resolve (tested).
 _LAZY_EXPORTS = {
     # image layer

@@ -9,8 +9,10 @@ from sparkdl_tpu.core.mesh import (
     MeshConfig, make_mesh, data_parallel_mesh, batch_sharding, replicated,
     shard_batch,
 )
+from sparkdl_tpu.core.executor import DeviceExecutor
 from sparkdl_tpu.core.model_function import ModelFunction, InputModel, TensorSpec
 from sparkdl_tpu.core import batching
+from sparkdl_tpu.core import executor
 from sparkdl_tpu.core import health
 from sparkdl_tpu.core import pipeline
 from sparkdl_tpu.core import resilience
@@ -29,8 +31,10 @@ __all__ = [
     "MeshConfig", "make_mesh", "data_parallel_mesh", "batch_sharding",
     "replicated", "shard_batch",
     "ModelFunction", "InputModel", "TensorSpec",
-    "batching", "health", "pipeline", "resilience", "telemetry",
-    "Deadline", "DevicePrefetcher", "Fault", "FaultInjector",
+    "batching", "executor", "health", "pipeline", "resilience",
+    "telemetry",
+    "Deadline", "DeviceExecutor", "DevicePrefetcher", "Fault",
+    "FaultInjector",
     "HealthMonitor", "MetricsRegistry", "RetryPolicy", "RunReport",
     "Telemetry", "Tracer", "classify",
 ]

@@ -11,7 +11,8 @@ shapes".)
 from __future__ import annotations
 
 import logging
-from typing import Callable, Iterator, List, Optional, Tuple
+import threading
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
@@ -103,6 +104,19 @@ def iter_batches_tree(tree, batch_size: int, multiple: int = 1):
         yield treedef.unflatten(chunk_leaves), n_valid
 
 
+def element_signature(tree) -> Tuple:
+    """Per-leaf (element shape, dtype) signature of a dim-0-batched pytree.
+
+    The identity under which rows are interchangeable: the executor's
+    coalescer only concatenates requests sharing a signature, and the
+    empty-output template memoization keys on it.
+    """
+    import jax
+
+    return tuple((tuple(leaf.shape[1:]), str(leaf.dtype))
+                 for leaf in jax.tree_util.tree_leaves(tree))
+
+
 def _valid_rows(chunk, n_valid: int):
     """Strip pad rows: the original (unpadded) rows of a padded chunk."""
     import jax
@@ -160,6 +174,64 @@ def _dispatch_chunk(fn: Callable, chunk, n_valid: int,
             out.extend(_dispatch_chunk(fn, sub, sub_valid,
                                        multiple, policy))
         return out
+
+
+# Memoized empty-output templates: (id(fn), element shapes/dtypes) →
+# (weakref-to-fn, output element shapes/dtypes + treedef). The fn is held
+# WEAKLY with a drop-on-collect callback, so memoization never pins a
+# discarded model's jitted closure (and the weights it captures); the
+# stored ref also guards against an id() recycled onto a different fn.
+# Non-weakref-able callables fall back to a strong ref (rare; bounded by
+# the caller's own lifetime management).
+_EMPTY_TEMPLATES: Dict[Tuple, Tuple[Callable[[], Any], Any]] = {}
+_EMPTY_LOCK = threading.Lock()
+
+
+def _empty_result(fn: Callable, tree, batch_size: int):
+    """Zero-row output matching ``fn``'s output element shapes.
+
+    The shape inference (``jax.eval_shape`` — a full trace) runs once per
+    (fn, input element shape/dtype) and is memoized: the output element
+    shape does not depend on the batch size, so every later empty call
+    rebuilds the zero-row arrays from the cached template. The trace uses
+    ``fn.__sparkdl_trace_target__`` when present (``ModelFunction.jitted``'s
+    compile-span wrapper exposes it; a dedicated attribute so a caller's
+    own functools-wrapped fn is never unwrapped by accident): tracing the
+    wrapper itself would record a phantom compile span and hide the real
+    first-launch one.
+    """
+    import weakref
+
+    import jax
+
+    key = (id(fn), element_signature(tree))
+    with _EMPTY_LOCK:
+        hit = _EMPTY_TEMPLATES.get(key)
+    if hit is None or hit[0]() is not fn:
+        dummy_in = jax.tree_util.tree_map(
+            lambda leaf: jax.ShapeDtypeStruct(
+                (batch_size,) + leaf.shape[1:], leaf.dtype), tree)
+        dummy = jax.eval_shape(
+            getattr(fn, "__sparkdl_trace_target__", fn), dummy_in)
+        out_leaves, treedef_out = jax.tree_util.tree_flatten(dummy)
+        template = ([(tuple(d.shape[1:]), np.dtype(d.dtype))
+                     for d in out_leaves], treedef_out)
+
+        def _drop(_ref, _key=key):
+            with _EMPTY_LOCK:
+                _EMPTY_TEMPLATES.pop(_key, None)
+
+        try:
+            ref: Callable[[], Any] = weakref.ref(fn, _drop)
+        except TypeError:  # non-weakref-able callable: strong fallback
+            ref = (lambda _fn=fn: _fn)
+        with _EMPTY_LOCK:
+            _EMPTY_TEMPLATES[key] = (ref, template)
+    else:
+        template = hit[1]
+    elements, treedef_out = template
+    return treedef_out.unflatten(
+        [np.zeros((0,) + shape, dtype=dtype) for shape, dtype in elements])
 
 
 def _record_chunk_metrics(chunk, n_valid: int) -> None:
@@ -237,15 +309,10 @@ def run_batched(fn: Callable, tree, batch_size: int,
                 outs.append(out)
                 valids.append(v)
     if not outs:
-        # Preserve the output *element* shape for empty inputs: run one
-        # dummy padded batch through shape inference only.
-        dummy_in = jax.tree_util.tree_map(
-            lambda leaf: jax.ShapeDtypeStruct(
-                (batch_size,) + leaf.shape[1:], leaf.dtype), tree)
-        dummy = jax.eval_shape(fn, dummy_in)
-        return jax.tree_util.tree_map(
-            lambda d: np.zeros((0,) + tuple(d.shape[1:]),
-                               dtype=np.dtype(d.dtype)), dummy)
+        # Preserve the output *element* shape for empty inputs (memoized
+        # per (fn, element shape/dtype) — empty partitions in a
+        # quarantined stream must not pay repeated tracing).
+        return _empty_result(fn, tree, batch_size)
 
     flat_outs = [jax.tree_util.tree_flatten(o) for o in outs]
     treedef_out = flat_outs[0][1]

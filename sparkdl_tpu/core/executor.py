@@ -1,0 +1,728 @@
+"""Device execution service: cross-partition dynamic batch coalescing.
+
+BENCH_r05 showed the device starved on exactly the workload the framework
+serves — the featurize/transform path: MFU 0.09 (EfficientNetB0), 0.20
+(DenseNet121), 0.28 (InceptionV3). The cause is structural: every engine
+partition task (``engine/dataframe.py`` pool → transformer op →
+``ModelFunction.apply_batch``) stages its own ≤ ``batch_size`` chunk and
+issues its own device launch, so an 8-way partitioned DataFrame runs 8
+small serial launches instead of one full bucket, and dispatch overhead
+dominates for cheap models.
+
+This module is the process-wide fix: transformers enter the device through
+ONE choke point, :func:`execute`, and concurrent small requests against
+the same compiled function are **coalesced** into one padded
+bucket-ladder launch:
+
+- worker threads submit ``(compiled-fn, rows)`` requests to a
+  per-compiled-fn queue;
+- a coalescer thread drains the queue under a bounded wait window
+  (``EngineConfig.coalesce_window_ms``; default an adaptive fraction of
+  the observed request latency) and a max-bucket cap, concatenates the
+  requests into one padded launch, dispatches it async, slices each
+  request's output rows back **on device**, and completes the requesters'
+  futures in submission order — each requester then pays its own single
+  device→host fetch for exactly its rows;
+- a **solo request under no contention takes the existing inline path**
+  (``apply_batch`` on the caller's thread) with zero added latency — the
+  service only changes behavior when there is someone to coalesce with.
+
+Composition with the existing layers (the invariants tests pin down):
+
+- **bit-identical, order-preserving**: a coalesced launch computes the
+  same per-row values as per-request launches (row-wise models are
+  bucket-size invariant — the same invariance the OOM re-chunk path has
+  always relied on), and every requester gets its rows back in its own
+  submission order;
+- **resilience**: classification applies per super-batch — ANY failure
+  (transient, OOM, FATAL) splits the launch back into per-request
+  sub-launches via ``apply_batch`` on the requesters' own threads, so a
+  transient's classified retry/backoff runs per request (never a sleep
+  on the coalescer thread, which would stall every queued sibling), an
+  OOM re-chunks exactly as the non-coalesced path would, and a poisoned
+  request fails alone instead of taking its coalesced siblings down
+  with it (ops are pure by the engine's contract, so the replay is
+  safe);
+- **supervision**: the supervisor's deadline watchdog and hedging bound
+  each *task* as before (the window is bounded, so a blocked requester
+  always unblocks); a hedged duplicate attempt carries its task's token
+  (:func:`task_scope`, set by ``engine/supervisor.py``) and **dedups
+  before coalescing** — while its sibling's request is still queued the
+  attempts share one future instead of launching the same rows twice,
+  and once the sibling has launched the hedge re-runs independently so
+  speculation can still win past a stalled launch;
+- **telemetry**: coalesce-size and queue-wait histograms, a launch
+  histogram and an executor occupancy gauge (docs/OBSERVABILITY.md);
+- **training never coalesces**: ``Trainer.fit`` owns its own step program
+  (donated state threading, deferred sync) and never routes through this
+  module — coalescing across training steps would interleave state
+  updates from unrelated streams.
+
+Shutdown never leaks a future: :func:`shutdown` (and interpreter exit)
+fails every queued request with :class:`ExecutorShutdown`, so a worker
+blocked mid-window always completes or raises.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from sparkdl_tpu.core import batching, health, resilience, telemetry
+
+logger = logging.getLogger(__name__)
+
+# Adaptive window bounds (seconds) when EngineConfig.coalesce_window_ms is
+# None: a fraction of the observed end-to-end request latency, clamped so
+# the window neither busy-spins on microsecond models nor adds visible
+# latency to slow ones.
+_WINDOW_FRACTION = 0.25
+_WINDOW_MIN_S = 0.0005
+_WINDOW_MAX_S = 0.02
+_WINDOW_DEFAULT_S = 0.002
+# Idle coalescer threads exit after this long with an empty queue (and
+# restart on the next queued request), so tests and long-lived processes
+# don't accumulate one parked thread per model ever served.
+_IDLE_EXIT_S = 5.0
+
+
+class ExecutorShutdown(RuntimeError):
+    """The execution service was shut down with this request still queued."""
+
+
+# ---------------------------------------------------------------------------
+# Task tokens (hedge dedup)
+# ---------------------------------------------------------------------------
+
+_tls = threading.local()
+
+
+def current_task_token() -> Optional[Tuple]:
+    """The ambient dedup identity for THIS executor call: the task token
+    set by :func:`task_scope` extended with the attempt's call sequence
+    number. Ops are pure and deterministic (the engine contract), so the
+    N-th device call of a task's hedge attempt computes the same rows as
+    the N-th call of its primary — the sequence number keeps a task whose
+    op chain enters the device several times (e.g. two chained
+    transformers sharing one model) from dedup'ing call N onto call M.
+    Each read advances the sequence. None outside a scope."""
+    token = getattr(_tls, "token", None)
+    if token is None:
+        return None
+    seq = _tls.seq
+    _tls.seq = seq + 1
+    return token + (seq,)
+
+
+def reset_call_sequence() -> None:
+    """Restart the ambient token's device-call sequence. The supervisor
+    calls this at the start of EVERY retry-loop attempt inside a pool
+    attempt's :class:`task_scope` (``run_partition_task``'s classified
+    retries re-run the op chain from the top, so their device calls
+    restart at call 0) — without the reset a retried primary's call 0
+    would sit at seq N while a fresh hedge's call 0 sits at seq 0, and
+    the hedge's call N could dedup onto the WRONG device call's output.
+    No-op outside a scope."""
+    if getattr(_tls, "token", None) is not None:
+        _tls.seq = 0
+
+
+class task_scope:
+    """Mark device requests from this thread as belonging to one logical
+    task attempt. The supervisor wraps every pool attempt (primary,
+    retry, hedge) of a task in the SAME token (each attempt — including
+    each retry-loop attempt inside a pool attempt, via
+    :func:`reset_call_sequence` — restarting the call-sequence counter),
+    so a hedged duplicate submitting the same rows while its sibling's
+    request is still pending shares that request's future instead of
+    coalescing the rows twice."""
+
+    def __init__(self, token: Tuple) -> None:
+        self._token = token
+        self._prev: Optional[Tuple] = None
+        self._prev_seq = 0
+
+    def __enter__(self) -> "task_scope":
+        self._prev = getattr(_tls, "token", None)
+        self._prev_seq = getattr(_tls, "seq", 0)
+        _tls.token = self._token
+        _tls.seq = 0
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        _tls.token = self._prev
+        _tls.seq = self._prev_seq
+
+
+# ---------------------------------------------------------------------------
+# Requests and per-compiled-fn state
+# ---------------------------------------------------------------------------
+
+
+class _ReplayInline:
+    """Sentinel future result: the coalescer handed the request back for
+    the REQUESTER'S OWN thread to run via ``apply_batch`` (solo drained
+    window, or a member of a terminally-failed super-batch). Executing
+    these on the coalescer thread would serialize device work that pool
+    threads previously overlapped — and block every queued sibling
+    behind one request's fetch and retry-backoff sleeps."""
+
+    __slots__ = ()
+
+
+_REPLAY_INLINE = _ReplayInline()
+
+
+class _Request:
+    """One queued submission: host-staged rows + the future that will carry
+    the ON-DEVICE output slices back to the requester."""
+
+    __slots__ = ("tree", "rows", "future", "token", "policy", "ctx",
+                 "t_enqueue", "launched")
+
+    def __init__(self, tree: Any, rows: int, token: Optional[Tuple],
+                 policy: resilience.RetryPolicy) -> None:
+        self.tree = tree
+        self.rows = rows
+        self.future: "Future[Any]" = Future()
+        self.token = token
+        self.policy = policy
+        self.ctx = telemetry.current_context()
+        self.t_enqueue = time.monotonic()
+        # set when the coalescer drains this request: dedup only shares
+        # PRE-launch requests, so a hedge arriving later re-executes
+        # independently and speculation can still win past a launch that
+        # stalled on the device
+        self.launched = False
+
+
+class _FnState:
+    """Coalescing state for one compiled fn (one bucket ladder).
+
+    Keyed by the jitted callable's identity — a strong reference is held
+    here, so the id can never be recycled while the state exists. All
+    fields are guarded by ``cond``'s lock except the immutable config.
+    """
+
+    def __init__(self, key: Tuple, fn: Any, model: Any, batch_size: int,
+                 mesh: Any, multiple: int) -> None:
+        self.key = key
+        self.fn = fn
+        self.model = model
+        self.batch_size = batch_size  # caller's batch_size (pre mesh pad)
+        self.mesh = mesh
+        self.multiple = multiple
+        self.cond = threading.Condition()
+        self.pending: "deque[_Request]" = deque()
+        self.dedup: Dict[Tuple, _Request] = {}
+        self.inflight = 0           # launches running (inline + coalesced)
+        self.window_s: Optional[float] = None  # None = adaptive
+        self.cap = batch_size
+        self.latency_ewma: Optional[float] = None
+        self.thread: Optional[threading.Thread] = None
+        self.last_used = time.monotonic()
+
+    def effective_window(self) -> float:
+        if self.window_s is not None:
+            return self.window_s
+        if self.latency_ewma is None:
+            return _WINDOW_DEFAULT_S
+        return min(max(self.latency_ewma * _WINDOW_FRACTION,
+                       _WINDOW_MIN_S), _WINDOW_MAX_S)
+
+    def note_latency(self, seconds: float) -> None:
+        prev = self.latency_ewma
+        self.latency_ewma = (seconds if prev is None
+                             else 0.8 * prev + 0.2 * seconds)
+
+
+class DeviceExecutor:
+    """The process-wide coalescing service (one instance per process; the
+    module-level :func:`execute` routes through :func:`service`)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._states: Dict[Tuple, _FnState] = {}
+        self._closed = False
+        self._thread_seq = 0
+        self._inflight_total = 0  # O(1) occupancy counter (gauge source)
+
+    # -- submission ----------------------------------------------------------
+
+    def submit(self, model: Any, tree: Any, rows: int, batch_size: int,
+               mesh: Any, multiple: int, policy: resilience.RetryPolicy,
+               window_s: Optional[float], cap: int,
+               prefetch: int) -> Any:
+        """Run ``rows`` staged rows through the model, coalescing with any
+        concurrent sibling requests against the same compiled fn. Returns
+        host numpy (structure mirrors the model output). Blocking."""
+        fn = model.jitted(mesh=mesh)
+        state = self._state(fn, model, batch_size, mesh, multiple)
+        token = current_task_token()
+        t0 = time.monotonic()
+        request: Optional[_Request] = None
+        inline = False
+        with state.cond:
+            if self._closed:
+                raise ExecutorShutdown("device execution service is shut "
+                                       "down")
+            state.window_s = window_s
+            state.cap = cap
+            if token is not None:
+                dup = state.dedup.get(token)
+                if (dup is not None and dup.rows == rows
+                        and not dup.launched and not dup.future.done()):
+                    # hedged duplicate of a sibling attempt whose request
+                    # is still QUEUED: share its future — the rows
+                    # coalesce exactly once. An already-launched (or
+                    # inline) sibling is NOT shared: the hedge re-runs
+                    # the pure ops independently, so speculation can
+                    # still win past a launch stalled on the device.
+                    request = dup
+                    telemetry.count(telemetry.M_COALESCE_DEDUP)
+            if request is None:
+                if state.inflight == 0 and not state.pending:
+                    # solo under no contention: the existing inline path
+                    # on the caller's thread — zero added latency.
+                    # inflight is bumped first so siblings arriving
+                    # meanwhile queue up for the coalescer instead of
+                    # serializing behind us.
+                    state.inflight += 1
+                    self._note_inflight(1)
+                    inline = True
+                else:
+                    request = _Request(tree, rows, token, policy)
+                    state.pending.append(request)
+                    if token is not None:
+                        state.dedup[token] = request
+                    self._ensure_thread(state)
+                    state.cond.notify_all()
+        if not inline:
+            return self._await(state, request, t0)
+        try:
+            return model.apply_batch(tree, batch_size=batch_size,
+                                     mesh=mesh, retry_policy=policy,
+                                     prefetch=prefetch)
+        finally:
+            with state.cond:
+                state.inflight -= 1
+                state.note_latency(time.monotonic() - t0)
+                self._note_inflight(-1)
+
+    def _await(self, state: _FnState, request: _Request, t0: float) -> Any:
+        """Block on the request's future and pay the requester's single
+        device→host fetch per output leaf (slices arrive device-resident
+        with the pad rows already cut off).
+
+        Dispatch is async, so a launch that failed at EXECUTION time (a
+        real device OOM the dispatch-side classification never saw)
+        surfaces here, at the fetch. That path re-runs THIS request alone
+        through ``apply_batch`` — its classified retry and OOM
+        bucket-halving apply, and a poisoned sibling cannot take this
+        request down with it. Errors delivered via ``set_exception``
+        already went through per-request isolation and propagate as-is.
+        """
+        import jax
+
+        out = request.future.result()  # isolated failures raise here
+        if isinstance(out, _ReplayInline):
+            # handed back by the coalescer (solo drained window, or a
+            # terminal super-batch failure split): run the model's own
+            # chunked path HERE, on the requester's thread — classified
+            # retry and OOM bucket-halving apply per request, and the
+            # coalescer thread stays free to drain siblings
+            try:
+                return state.model.apply_batch(
+                    request.tree, batch_size=state.batch_size,
+                    mesh=state.mesh, retry_policy=request.policy,
+                    prefetch=0)
+            finally:
+                with state.cond:
+                    state.note_latency(time.monotonic() - t0)
+        try:
+            host = jax.tree_util.tree_map(np.asarray, out)
+        except Exception as e:  # noqa: BLE001 - classified, then replayed
+            kind = resilience.classify(e)
+            if kind == resilience.OOM:
+                health.record(health.OOM_RECHUNK, rows=request.rows,
+                              at="fetch")
+            logger.warning(
+                "coalesced result fetch failed (%s: %s; classified %s); "
+                "re-running the %d-row request alone", type(e).__name__,
+                e, kind, request.rows)
+            host = state.model.apply_batch(
+                request.tree, batch_size=state.batch_size,
+                mesh=state.mesh, retry_policy=request.policy, prefetch=0)
+        with state.cond:
+            state.note_latency(time.monotonic() - t0)
+        return host
+
+    # -- state / thread management -------------------------------------------
+
+    def _state(self, fn: Any, model: Any, batch_size: int, mesh: Any,
+               multiple: int) -> _FnState:
+        key = (id(fn), batch_size, multiple)
+        with self._lock:
+            state = self._states.get(key)
+            if state is None or state.fn is not fn:
+                self._sweep_stale_locked()
+                state = _FnState(key, fn, model, batch_size, mesh,
+                                 multiple)
+                self._states[key] = state
+            state.last_used = time.monotonic()
+            return state
+
+    def _retire_locked(self, state: _FnState, now: float) -> None:
+        """Drop a fully-quiesced idle state from the registry — the ONE
+        definition of the retirement invariant, shared by the coalescer's
+        idle exit and the opportunistic new-state sweep. BOTH state.cond
+        and self._lock must be held."""
+        if (not state.pending and state.inflight == 0
+                and state.thread is None
+                and now - state.last_used >= _IDLE_EXIT_S
+                and self._states.get(state.key) is state):
+            del self._states[state.key]
+
+    def _sweep_stale_locked(self) -> None:
+        """Drop idle states so the service never pins a discarded model's
+        weights for the process lifetime (model churn: CrossValidator,
+        notebooks). Called with self._lock held, on the rare new-state
+        path; a state's cond is only probed non-blocking — the canonical
+        lock order is cond→lock, so blocking here could deadlock."""
+        now = time.monotonic()
+        for state in list(self._states.values()):
+            if now - state.last_used < _IDLE_EXIT_S:
+                continue
+            if not state.cond.acquire(blocking=False):
+                continue  # busy: next sweep gets it
+            try:
+                self._retire_locked(state, now)
+            finally:
+                state.cond.release()
+
+    def _ensure_thread(self, state: _FnState) -> None:
+        # caller holds state.cond
+        if state.thread is not None and state.thread.is_alive():
+            return
+        with self._lock:
+            self._thread_seq += 1
+            seq = self._thread_seq
+        state.thread = threading.Thread(
+            target=self._coalesce_loop, args=(state,),
+            name=f"sparkdl-exec-{seq}", daemon=True)
+        state.thread.start()
+
+    def _note_inflight(self, delta: int) -> None:
+        """O(1) process-wide in-flight accounting feeding the occupancy
+        gauge (no cross-state sums on the per-request hot path)."""
+        with self._lock:
+            self._inflight_total += delta
+            total = self._inflight_total
+        if telemetry.active() is not None:
+            telemetry.gauge_set(telemetry.M_EXECUTOR_OCCUPANCY, total)
+
+    # -- the coalescer -------------------------------------------------------
+
+    def _coalesce_loop(self, state: _FnState) -> None:
+        # `crashed` guards the terminal fail-pending: an IDLE exit hands
+        # the (empty) queue back cleanly — failing in that window could
+        # race a fresh submit that already started a successor thread.
+        crashed = True
+        try:
+            while True:
+                with state.cond:
+                    idle_since = time.monotonic()
+                    while not state.pending and not self._closed:
+                        state.cond.wait(timeout=_IDLE_EXIT_S)
+                        if (not state.pending and not self._closed
+                                and time.monotonic() - idle_since
+                                >= _IDLE_EXIT_S):
+                            state.thread = None
+                            crashed = False
+                            # retire the whole state with the thread so
+                            # an abandoned model's weights don't stay
+                            # pinned — unless the inline fast path is
+                            # still using it (fresh last_used)
+                            with self._lock:
+                                self._retire_locked(state,
+                                                    time.monotonic())
+                            return
+                    if self._closed:
+                        crashed = False
+                        return
+                    # bounded wait window, anchored at the head request's
+                    # arrival: late siblings join until the window closes
+                    # or the bucket cap is reached
+                    deadline = (state.pending[0].t_enqueue
+                                + state.effective_window())
+                    while not self._closed:
+                        total = sum(r.rows for r in state.pending)
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0 or total >= state.cap:
+                            break
+                        state.cond.wait(timeout=remaining)
+                    if self._closed:
+                        crashed = False
+                        return
+                    batch: List[_Request] = []
+                    total = 0
+                    while state.pending:
+                        nxt = state.pending[0]
+                        if batch and total + nxt.rows > state.cap:
+                            break  # leave the rest for the next round
+                        nxt.launched = True  # past dedup's sharing window
+                        batch.append(state.pending.popleft())
+                        total += nxt.rows
+                    state.inflight += 1
+                    self._note_inflight(1)
+                try:
+                    self._launch(state, batch, total)
+                except BaseException as e:  # taxonomy-ok: not a retry — the error is delivered to every drained future
+                    # a failure in the launch plumbing itself (concat,
+                    # slicing) must still complete every drained future —
+                    # the batch already left `pending`, so the terminal
+                    # fail-pending sweep would miss it
+                    logger.exception(
+                        "coalescer launch plumbing failed; delivering the "
+                        "error to all %d drained request(s)", len(batch))
+                    for r in batch:
+                        if not r.future.done():
+                            r.future.set_exception(e)
+                finally:
+                    with state.cond:
+                        state.inflight -= 1
+                        for r in batch:
+                            if (r.token is not None
+                                    and state.dedup.get(r.token) is r):
+                                del state.dedup[r.token]
+                        self._note_inflight(-1)
+        finally:
+            if crashed or self._closed:
+                self._fail_pending(state,
+                                   ExecutorShutdown(
+                                       "device execution service shut "
+                                       "down with this request still "
+                                       "queued"))
+
+    def _fail_pending(self, state: _FnState, error: BaseException) -> None:
+        with state.cond:
+            pending = list(state.pending)
+            state.pending.clear()
+            state.dedup.clear()
+            if state.thread is threading.current_thread():
+                state.thread = None
+        for r in pending:
+            if not r.future.done():
+                r.future.set_exception(error)
+
+    def _launch(self, state: _FnState, batch: List[_Request],
+                total_rows: int) -> None:
+        """Dispatch one drained window. Requests are grouped by element
+        signature first — one jitted fn can legally serve several shapes
+        (e.g. uniform image batches of different sizes), and rows only
+        concatenate within a shape. A group of one is handed back to run
+        inline on its requester's thread; larger groups concatenate into
+        one padded launch whose outputs are sliced back per request ON
+        DEVICE."""
+        t0 = time.monotonic()
+        now = t0
+        for r in batch:
+            telemetry.observe(telemetry.M_QUEUE_WAIT_S, now - r.t_enqueue)
+        groups: Dict[Tuple, List[_Request]] = {}
+        for r in batch:
+            groups.setdefault(batching.element_signature(r.tree),
+                              []).append(r)
+        for group in groups.values():
+            rows = sum(r.rows for r in group)
+            telemetry.observe(telemetry.M_COALESCE_REQUESTS, len(group),
+                              bounds=telemetry.POW2_BOUNDS)
+            telemetry.observe(telemetry.M_COALESCE_ROWS, rows,
+                              bounds=telemetry.POW2_BOUNDS)
+            if len(group) == 1:
+                self._hand_back(group[0])
+            else:
+                self._run_coalesced(state, group, rows)
+        telemetry.observe(telemetry.M_LAUNCH_S, time.monotonic() - t0)
+
+    @staticmethod
+    def _hand_back(r: _Request) -> None:
+        """Per-request sub-launch: deliver the replay sentinel so the
+        REQUESTER'S thread runs the model's own chunked path in `_await`
+        (its classified retry and OOM bucket-halving apply unchanged).
+        Requests of a split window replay concurrently on their own pool
+        threads instead of serializing through the coalescer."""
+        if not r.future.done():
+            r.future.set_result(_REPLAY_INLINE)
+
+    def _run_coalesced(self, state: _FnState, batch: List[_Request],
+                       total_rows: int) -> None:
+        import jax
+
+        failure: Optional[Exception] = None
+        slices: List[Any] = []
+        # The span closes BEFORE any future is delivered: a requester that
+        # tears its telemetry scope down the moment its result arrives
+        # still finds the launch span recorded.
+        with telemetry.span(telemetry.SPAN_COALESCED_LAUNCH,
+                            parent=batch[0].ctx,
+                            requests=len(batch), rows=total_rows):
+            flat = [jax.tree_util.tree_flatten(r.tree) for r in batch]
+            treedef = flat[0][1]
+            cat_leaves = [np.concatenate([f[0][j] for f in flat], axis=0)
+                          for j in range(len(flat[0][0]))]
+            bucket = batching.bucket_size(total_rows, state.cap,
+                                          state.multiple)
+            padded = treedef.unflatten(
+                [batching.pad_batch(leaf, bucket)[0]
+                 for leaf in cat_leaves])
+            fn = state.fn
+            # the HEAD request's policy decides whether a transient
+            # counts as a retry for accounting; the actual retries run
+            # per request under each request's OWN policy (the hand-back
+            # below) — never as a backoff sleep on the coalescer thread,
+            # which would stall every queued sibling for the duration
+            policy = batch[0].policy
+            try:
+                resilience.inject("device_oom", rows=bucket,
+                                  valid=total_rows)
+                resilience.inject("transfer_stall", rows=bucket,
+                                  valid=total_rows)
+                out = fn(padded)  # dispatched async; no block here
+            except Exception as e:  # noqa: BLE001 - classified below
+                kind = resilience.classify(e)
+                if kind == resilience.OOM:
+                    health.record(health.OOM_RECHUNK, bucket=bucket,
+                                  requests=len(batch))
+                elif (kind == resilience.RETRYABLE
+                        and policy.max_retries > 0):
+                    # CHUNK_RETRY parity with the chunk path: the failed
+                    # super-batch IS retried — per request, on the
+                    # requesters' own threads via the replay sentinel
+                    health.record(health.CHUNK_RETRY, bucket=bucket,
+                                  attempt=1, error=type(e).__name__)
+                failure = e
+            else:
+                out_leaves, out_treedef = jax.tree_util.tree_flatten(out)
+                off = 0
+                for r in batch:
+                    slices.append(out_treedef.unflatten(
+                        [leaf[off:off + r.rows] for leaf in out_leaves]))
+                    off += r.rows
+        if failure is not None:
+            # ANY super-batch failure splits back into per-request
+            # sub-launches on the requesters' own threads. A transient
+            # retries there under each request's policy (backoff sleeps
+            # never park the coalescer); an OOM re-chunks exactly as the
+            # non-coalesced path would (apply_batch's bucket-halving per
+            # request); a FATAL poisons only its own request instead of
+            # the whole window. Ops are pure (engine contract), so the
+            # replay is safe and bit-identical.
+            logger.warning(
+                "coalesced launch of %d request(s) failed (%s: %s); "
+                "splitting back to per-request sub-launches",
+                len(batch), type(failure).__name__, failure)
+            for r in batch:
+                self._hand_back(r)
+            return
+        for r, sliced in zip(batch, slices):
+            if not r.future.done():
+                r.future.set_result(sliced)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def shutdown(self) -> None:
+        """Stop every coalescer thread; fail every queued request with
+        :class:`ExecutorShutdown`. In-flight launches complete. No future
+        is ever left pending."""
+        with self._lock:
+            self._closed = True
+            states = list(self._states.values())
+        err = ExecutorShutdown("device execution service shut down with "
+                               "this request still queued")
+        for state in states:
+            with state.cond:
+                state.cond.notify_all()
+                thread = state.thread
+            if thread is not None and thread is not threading.current_thread():
+                thread.join(timeout=5.0)
+            self._fail_pending(state, err)
+
+
+# ---------------------------------------------------------------------------
+# Module-level service + the choke point
+# ---------------------------------------------------------------------------
+
+_service = DeviceExecutor()
+_service_lock = threading.Lock()
+
+
+def service() -> DeviceExecutor:
+    return _service
+
+
+def shutdown() -> None:
+    """Shut the process-wide service down (fails queued requests)."""
+    _service.shutdown()
+
+
+def reset() -> DeviceExecutor:
+    """Shut down and replace the process-wide service (test isolation)."""
+    global _service
+    with _service_lock:
+        old = _service
+        _service = DeviceExecutor()
+    old.shutdown()
+    return _service
+
+
+def execute(model: Any, array: Any, *, batch_size: int = 64,
+            mesh: Any = None,
+            retry_policy: Optional[resilience.RetryPolicy] = None,
+            prefetch: int = 2, coalesce: Optional[bool] = None) -> Any:
+    """THE device entry point for the inference data plane.
+
+    Transformers call this instead of ``model.apply_batch`` (enforced by
+    the choke-point lint in ``tests/test_taxonomy_lint.py``): with
+    ``EngineConfig.coalesce`` on (the default), eligible requests —
+    non-empty, at most one bucket's worth of rows — route through the
+    coalescing service; everything else (and ``coalesce=False``) takes
+    the existing ``apply_batch`` path unchanged. ``coalesce=None`` reads
+    ``EngineConfig.coalesce``.
+    """
+    # Lazy layering: core must stay importable without the engine, but the
+    # coalescing knobs live with the other engine-wide knobs on
+    # EngineConfig (the class tests already snapshot/restore).
+    from sparkdl_tpu.engine.dataframe import EngineConfig
+
+    if coalesce is None:
+        coalesce = EngineConfig.coalesce
+    if not coalesce:
+        return model.apply_batch(array, batch_size=batch_size, mesh=mesh,
+                                 retry_policy=retry_policy,
+                                 prefetch=prefetch)
+    import jax
+
+    array = model.stage_inputs(array)
+    eff_batch, multiple = model.bucket_params(batch_size, mesh)
+    cap = eff_batch
+    if EngineConfig.coalesce_max_rows is not None:
+        cap = min(cap, int(EngineConfig.coalesce_max_rows))
+    rows = jax.tree_util.tree_leaves(array)[0].shape[0]
+    if rows == 0 or rows > cap:
+        # nothing to coalesce (empty partitions hit the memoized empty
+        # template) / already a full bucket or more: chunked path
+        return model.apply_batch(array, batch_size=batch_size, mesh=mesh,
+                                 retry_policy=retry_policy,
+                                 prefetch=prefetch)
+    window_ms = EngineConfig.coalesce_window_ms
+    window_s = None if window_ms is None else max(0.0, window_ms / 1e3)
+    policy = (retry_policy if retry_policy is not None
+              else resilience.DEFAULT_INFERENCE_POLICY)
+    return _service.submit(model, array, rows, batch_size, mesh, multiple,
+                           policy, window_s, cap, prefetch)

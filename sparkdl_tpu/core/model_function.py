@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from sparkdl_tpu.core import batching
+from sparkdl_tpu.core import batching, telemetry
 from sparkdl_tpu.core.mesh import batch_sharding, replicated
 
 
@@ -376,15 +376,70 @@ class ModelFunction:
             variables = self.variables
             kwargs: Dict[str, Any] = {"donate_argnums": (1,)} if donate_batch else {}
             jfn = jax.jit(apply_fn, **kwargs)
-            fn = lambda x: jfn(variables, x)  # noqa: E731
+            inner = lambda x: jfn(variables, x)  # noqa: E731
         else:
             variables = jax.device_put(self.variables, replicated(mesh))
             kwargs = {"donate_argnums": (0,)} if donate_batch else {}
-            fn = jax.jit(lambda x: apply_fn(variables, x),
-                         in_shardings=(batch_sharding(mesh),),
-                         out_shardings=batch_sharding(mesh), **kwargs)
+            inner = jax.jit(lambda x: apply_fn(variables, x),
+                            in_shardings=(batch_sharding(mesh),),
+                            out_shardings=batch_sharding(mesh), **kwargs)
+
+        # First launch of a new input shape traces+compiles synchronously
+        # inside the call — record it as a `sparkdl.compile` span so
+        # bucket-ladder compile storms are visible in the run report
+        # (set membership per dispatch otherwise; races at worst record a
+        # duplicate span). jax's persistent compilation cache, when wired
+        # via SPARKDL_COMPILE_CACHE_DIR (package __init__), makes these
+        # spans near-zero on warm processes.
+        seen_shapes: set = set()
+        name = self.name
+
+        def fn(x, _inner=inner, _seen=seen_shapes):
+            shape_key = tuple((tuple(leaf.shape), str(leaf.dtype))
+                              for leaf in jax.tree_util.tree_leaves(x))
+            if shape_key in _seen:
+                return _inner(x)
+            with telemetry.span(telemetry.SPAN_COMPILE, model=name,
+                                shapes=repr(shape_key)):
+                out = _inner(x)
+            _seen.add(shape_key)
+            return out
+
+        # Shape-inference callers (batching._empty_result) must trace the
+        # UNWRAPPED program: tracing this wrapper would record a phantom
+        # zero-cost compile span and mark the shape seen, hiding the real
+        # first-launch compile from the run report. A dedicated attribute,
+        # NOT functools' `__wrapped__` — a caller's own wraps()-decorated
+        # fn must not have its inner fn traced by accident.
+        fn.__sparkdl_trace_target__ = inner
         self._jit_cache[key] = fn
         return fn
+
+    def stage_inputs(self, array):
+        """Host-side staging cast for :meth:`apply_batch` (and the device
+        execution service, core/executor.py): uint8 stays uint8 — the
+        jitted program casts on device, quartering the transfer bytes —
+        anything else is cast to the spec dtype. Idempotent."""
+        def stage_cast(arr, spec):
+            arr = np.asarray(arr)
+            if arr.dtype != np.uint8 and arr.dtype != np.dtype(spec.dtype):
+                arr = arr.astype(spec.dtype)
+            return arr
+
+        if isinstance(self.input_spec, dict):
+            return {name: stage_cast(array[name], spec)
+                    for name, spec in self.input_spec.items()}
+        return stage_cast(array, self.input_spec)
+
+    def bucket_params(self, batch_size: int, mesh=None) -> Tuple[int, int]:
+        """(effective batch_size, bucket multiple) for a mesh: the batch
+        pads so every data-axis shard is equal (1 without a mesh)."""
+        if mesh is None:
+            return batch_size, 1
+        from sparkdl_tpu.core.mesh import data_axis_size, pad_to_multiple
+
+        multiple = data_axis_size(mesh)
+        return pad_to_multiple(batch_size, multiple), multiple
 
     def apply_batch(self, array, batch_size: int = 64,
                     mesh=None, retry_policy=None,
@@ -412,24 +467,9 @@ class ModelFunction:
         """
         from sparkdl_tpu.core import resilience
 
-        def stage_cast(arr, spec):
-            arr = np.asarray(arr)
-            if arr.dtype != np.uint8 and arr.dtype != np.dtype(spec.dtype):
-                arr = arr.astype(spec.dtype)
-            return arr
-
-        if isinstance(self.input_spec, dict):
-            array = {name: stage_cast(array[name], spec)
-                     for name, spec in self.input_spec.items()}
-        else:
-            array = stage_cast(array, self.input_spec)
+        array = self.stage_inputs(array)
         fn = self.jitted(mesh=mesh)
-        multiple = 1
-        if mesh is not None:
-            # pad batch_size so every data-axis shard is equal
-            from sparkdl_tpu.core.mesh import data_axis_size, pad_to_multiple
-            multiple = data_axis_size(mesh)
-            batch_size = pad_to_multiple(batch_size, multiple)
+        batch_size, multiple = self.bucket_params(batch_size, mesh)
         while True:
             try:
                 return batching.run_batched(fn, array, batch_size,

@@ -34,6 +34,13 @@ Design constraints honored here:
 ``depth=0`` degrades to synchronous inline staging on the caller's
 thread (no thread is created) — same iteration contract, zero overlap;
 the knob every caller can use to fall back to the serial behavior.
+
+Scope note (r8): this prefetcher overlaps *within* one multi-chunk
+stream (a large partition, a training epoch). The complementary
+*cross-stream* overlap — many partitions each holding less than one
+bucket of rows — is the device execution service's coalescer
+(``core/executor.py``): single-bucket requests skip the staging thread
+(nothing to stage ahead) and merge with concurrent siblings instead.
 """
 
 from __future__ import annotations

@@ -80,11 +80,14 @@ SPAN_COLLECT = "sparkdl.collect"              # estimator collected decode
 SPAN_MATERIALIZE = "sparkdl.materialize"      # DataFrame._materialize barrier
 SPAN_TASK = "sparkdl.task"                    # one pool attempt (or hedge)
 SPAN_TASK_ATTEMPT = "sparkdl.task_attempt"    # one retry-loop attempt
+SPAN_COMPILE = "sparkdl.compile"              # first launch of a new shape
+SPAN_COALESCED_LAUNCH = "sparkdl.coalesced_launch"  # core/executor.py
 
 CANONICAL_SPAN_NAMES = frozenset({
     SPAN_RUN, SPAN_RUNNER_ATTEMPT, SPAN_FIT, SPAN_EPOCH,
     SPAN_CHECKPOINT_SAVE, SPAN_ESTIMATOR_FIT, SPAN_COLLECT,
     SPAN_MATERIALIZE, SPAN_TASK, SPAN_TASK_ATTEMPT,
+    SPAN_COMPILE, SPAN_COALESCED_LAUNCH,
     # phase names (core/profiling.py constants + literal call sites)
     "sparkdl.decode", "sparkdl.stage", "sparkdl.stage_batch",
     "sparkdl.host_stage", "sparkdl.host_resize", "sparkdl.host_wait",
@@ -107,13 +110,21 @@ M_BATCH_BUCKET_ROWS = "sparkdl.batching.bucket_rows"   # histogram
 M_PADDING_WASTE = "sparkdl.batching.padding_waste"     # gauge (pad fraction)
 M_ENGINE_ROWS_OUT = "sparkdl.engine.rows_out"          # counter
 M_ENGINE_BYTES_OUT = "sparkdl.engine.bytes_out"        # counter
+# Device execution service (core/executor.py, docs/PERF.md coalescing):
+M_COALESCE_REQUESTS = "sparkdl.executor.coalesce_requests"  # histogram
+M_COALESCE_ROWS = "sparkdl.executor.coalesce_rows"     # histogram
+M_COALESCE_DEDUP = "sparkdl.executor.dedup_hits"       # counter (hedges)
+M_QUEUE_WAIT_S = "sparkdl.executor.queue_wait_s"       # histogram
+M_LAUNCH_S = "sparkdl.executor.launch_s"               # histogram (host)
+M_EXECUTOR_OCCUPANCY = "sparkdl.executor.occupancy"    # gauge (in-flight)
 HEALTH_METRIC_PREFIX = "sparkdl.health."
 
 CANONICAL_METRIC_NAMES = frozenset({
     M_TASK_DURATION_S, M_STEP_TIME_S, M_STEPS_PER_SEC, M_EXAMPLES_PER_SEC,
     M_PREFETCH_DEPTH, M_PREFETCH_STALL_S, M_BATCH_ROWS, M_BATCH_PAD_ROWS,
     M_BATCH_BUCKET_ROWS, M_PADDING_WASTE, M_ENGINE_ROWS_OUT,
-    M_ENGINE_BYTES_OUT,
+    M_ENGINE_BYTES_OUT, M_COALESCE_REQUESTS, M_COALESCE_ROWS,
+    M_COALESCE_DEDUP, M_QUEUE_WAIT_S, M_LAUNCH_S, M_EXECUTOR_OCCUPANCY,
 })
 
 # ---------------------------------------------------------------------------

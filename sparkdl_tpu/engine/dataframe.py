@@ -73,6 +73,19 @@ class EngineConfig:
     # recorded in the active HealthMonitor.
     quarantine: bool = False
     quarantine_max_fatal: int = 1
+    # -- cross-partition dynamic batch coalescing (core/executor.py) ----------
+    # The inference data plane's device execution service: concurrent
+    # partition tasks submitting small chunks against the same compiled fn
+    # are coalesced into one padded bucket-ladder launch (docs/PERF.md
+    # "Cross-partition coalescing"). Default ON for inference; the
+    # training path (Trainer.fit) never routes through the service. A solo
+    # request under no contention takes the inline path unchanged.
+    coalesce: bool = True
+    # Bounded wait (milliseconds) for sibling requests before launching;
+    # None = adaptive (a fraction of the observed request latency).
+    coalesce_window_ms: Optional[float] = None
+    # Row cap of one coalesced launch; None = the request's batch_size.
+    coalesce_max_rows: Optional[int] = None
     max_workers: int = max(2, (os.cpu_count() or 4) // 2)
     # DEPRECATED test hook (SURVEY.md §5.3 fault injection):
     # callable(partition_index, attempt) that may raise to simulate a task

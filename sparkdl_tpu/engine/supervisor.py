@@ -31,6 +31,7 @@ Everything reports into :mod:`sparkdl_tpu.core.health`.
 from __future__ import annotations
 
 import concurrent.futures as _futures
+import itertools
 import logging
 import threading
 import time
@@ -39,6 +40,7 @@ from dataclasses import dataclass
 from typing import (Any, Callable, Dict, Iterable, Iterator, List, Optional,
                     Sequence, Tuple)
 
+from sparkdl_tpu.core import executor as _executor
 from sparkdl_tpu.core import health, resilience, telemetry
 
 logger = logging.getLogger(__name__)
@@ -151,6 +153,11 @@ def run_partition_task(index: int, batch: Any, ops: Sequence[Callable],
     health.record(health.TASK_STARTED, partition=index)
     while True:
         t0 = time.monotonic()
+        # each retry-loop attempt re-runs the op chain from the top, so
+        # its device calls restart at call 0 — realign the executor's
+        # hedge-dedup sequence, or a retried primary's call 0 (seq N)
+        # could cross-dedup a fresh hedge's call N onto the wrong output
+        _executor.reset_call_sequence()
         try:
             # one telemetry span per retry-loop attempt (ambient-parented
             # under the pool thread's sparkdl.task span, so a retried or
@@ -294,7 +301,10 @@ class _Task:
 
     __slots__ = ("index", "runner", "_submit", "holders", "futures",
                  "hedged", "done", "result", "error", "duration",
-                 "deadline_failed", "cancel_event", "trace_ctx")
+                 "deadline_failed", "cancel_event", "trace_ctx",
+                 "task_seq")
+
+    _task_counter = itertools.count(1)
 
     def __init__(self, index: int,
                  runner: Callable[[threading.Event], Any],
@@ -302,6 +312,7 @@ class _Task:
         self.index = index
         self.runner = runner
         self._submit = submit
+        self.task_seq = next(_Task._task_counter)
         # Captured on the SCHEDULING thread: every attempt of this task
         # (primary, retries inside it, a hedge duplicate) opens its pool-
         # thread span under this context, so they all share the task's
@@ -325,13 +336,23 @@ class _Task:
         ctx = self.trace_ctx
         index = self.index
 
+        # every attempt of this task (primary, hedge) shares one executor
+        # task token, so a hedged duplicate's device requests DEDUP onto
+        # the primary's still-queued coalescing request instead of
+        # launching the same rows twice (core/executor.py). The id comes
+        # from a monotonic counter, NOT id(self): a freed _Task's address
+        # can be recycled while a hedge loser's request is still queued,
+        # and a colliding token could hand a new task stale rows.
+        token = ("task", self.task_seq, index)
+
         def run(h=holder):
             h["started"] = time.monotonic()
             # explicit parent (NOT telemetry.attach): pool threads are
             # reused, an attached base would leak into the next task
             with telemetry.span(telemetry.SPAN_TASK, parent=ctx,
                                 partition=index, pool_attempt=attempt):
-                return runner(cancel_event)
+                with _executor.task_scope(token):
+                    return runner(cancel_event)
 
         self.holders.append(holder)
         fut = self._submit(run)

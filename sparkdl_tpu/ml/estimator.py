@@ -473,6 +473,12 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         return self._wrap_trained(mf, state, history)
 
     def _fit(self, dataset) -> "KerasImageFileModel":
+        # Training NEVER routes through the device execution service
+        # (core/executor.py): both fit paths feed Trainer's own step
+        # program (donated state threading, deferred sync) — coalescing
+        # across training steps would interleave state updates from
+        # unrelated streams. EngineConfig.coalesce only affects the
+        # fitted model's transform(), which is an inference path.
         streaming = bool(self.getKerasFitParams().get("streaming", True))
         with telemetry.span(telemetry.SPAN_ESTIMATOR_FIT,
                             streaming=streaming):

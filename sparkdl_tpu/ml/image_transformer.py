@@ -32,6 +32,7 @@ import pyarrow as pa
 
 logger = logging.getLogger(__name__)
 
+from sparkdl_tpu.core import executor as device_executor
 from sparkdl_tpu.core import profiling
 from sparkdl_tpu.engine.dataframe import fixed_size_list_array
 from sparkdl_tpu.image import imageIO
@@ -151,9 +152,13 @@ class TPUImageTransformer(Transformer, HasInputCol, HasOutputCol,
                                                           run)
                 with profiling.annotate("sparkdl.device_apply",
                                         rows=len(stacked)):
-                    out = run_fast.apply_batch(stacked, batch_size=batch_size,
-                                               mesh=mesh,
-                                               prefetch=_PREFETCH_DEPTH)
+                    # device entry via the execution-service choke point
+                    # (core/executor.py): concurrent partition chunks
+                    # against the same compiled fn coalesce into one
+                    # launch when EngineConfig.coalesce is on
+                    out = device_executor.execute(
+                        run_fast, stacked, batch_size=batch_size,
+                        mesh=mesh, prefetch=_PREFETCH_DEPTH)
                 if mode == "vector":
                     return _vectors_with_nulls(out, valid, batch.num_rows)
                 origins = col.field("origin").take(
@@ -186,8 +191,9 @@ class TPUImageTransformer(Transformer, HasInputCol, HasOutputCol,
                 return pa.array([None] * batch.num_rows, type=out_type)
             with profiling.annotate("sparkdl.device_apply",
                                     rows=len(stacked)):
-                out = run.apply_batch(stacked, batch_size=batch_size,
-                                      mesh=mesh, prefetch=_PREFETCH_DEPTH)
+                out = device_executor.execute(
+                    run, stacked, batch_size=batch_size, mesh=mesh,
+                    prefetch=_PREFETCH_DEPTH)
             if mode == "vector":
                 return _vectors_with_nulls(out, valid, batch.num_rows)
             return _images_with_nulls(out, valid, batch.num_rows,

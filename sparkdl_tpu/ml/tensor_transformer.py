@@ -14,6 +14,7 @@ from typing import Optional
 import numpy as np
 import pyarrow as pa
 
+from sparkdl_tpu.core import executor as device_executor
 from sparkdl_tpu.engine.dataframe import (
     _schema_with,
     _set_column,
@@ -142,7 +143,10 @@ class TPUTransformer(Transformer, HasInputCol, HasOutputCol,
             col = batch.column(batch.schema.get_field_index(input_col))
             block = column_to_block(col, element_shape)
             block = block.astype(model.input_spec.dtype, copy=False)
-            out = model.apply_batch(block, batch_size=batch_size, mesh=mesh)
+            # device entry via the execution-service choke point
+            # (core/executor.py): concurrent partition chunks coalesce
+            out = device_executor.execute(model, block,
+                                          batch_size=batch_size, mesh=mesh)
             out = np.asarray(out, dtype=np.float32).reshape(batch.num_rows, -1)
             return fixed_size_list_array(out).cast(pa.list_(pa.float32()))
 
@@ -193,7 +197,8 @@ class TPUTransformer(Transformer, HasInputCol, HasOutputCol,
                 spec = model.input_spec[input_name]
                 arr = batch.column(batch.schema.get_field_index(col))
                 blocks[input_name] = column_to_block(arr, spec.element_shape)
-            outs = model.apply_batch(blocks, batch_size=batch_size, mesh=mesh)
+            outs = device_executor.execute(model, blocks,
+                                           batch_size=batch_size, mesh=mesh)
             if not isinstance(outs, dict):
                 raise ValueError(
                     "outputMapping requires the model to return a "

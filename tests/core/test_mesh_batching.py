@@ -63,6 +63,41 @@ def test_run_batched_empty():
     assert out.shape[0] == 0
 
 
+def test_run_batched_empty_template_memoized_per_fn_and_shape():
+    """ISSUE 5 satellite: the empty-output template (a full jax.eval_shape
+    trace) is computed once per (fn, element shape/dtype) — empty
+    partitions in a quarantined stream must not pay repeated tracing."""
+    traces = []
+
+    def fn(b):
+        traces.append(b.shape)
+        return b * 2
+
+    empty = np.zeros((0, 4), np.float32)
+    out1 = run_batched(fn, empty, 8)
+    out2 = run_batched(fn, empty, 8)
+    assert out1.shape == out2.shape == (0, 4)
+    assert len(traces) == 1  # the second empty call reused the template
+    # batch_size does not change the element shape: still no new trace
+    out3 = run_batched(fn, empty, 16)
+    assert out3.shape == (0, 4) and len(traces) == 1
+    # a different element shape (or dtype) is a different template
+    run_batched(fn, np.zeros((0, 3), np.float32), 8)
+    assert len(traces) == 2
+    run_batched(fn, np.zeros((0, 4), np.int32), 8)
+    assert len(traces) == 3
+    # a different fn gets its own entry even at the same element shape
+    other_traces = []
+
+    def other(b):
+        other_traces.append(b.shape)
+        return b + 1
+
+    out4 = run_batched(other, empty, 8)
+    assert out4.shape == (0, 4)
+    assert len(other_traces) == 1 and len(traces) == 3
+
+
 def test_host_local_mesh_warns_when_discarding_model_axis(monkeypatch, caplog):
     """Substituting a data-only local mesh for a multi-host mesh with a
     non-trivial model axis must WARN: parameter sharding is silently lost

@@ -111,3 +111,42 @@ def test_jit_cache_reused(tiny):
     f1 = mf.jitted()
     f2 = mf.jitted()
     assert f1 is f2
+
+
+def test_first_launch_records_compile_span_once_per_shape():
+    """ISSUE 5 satellite: the first dispatch of each new input shape is
+    wrapped in a `sparkdl.compile` span (bucket-ladder compile storms are
+    visible in the run report); repeat dispatches at a seen shape are not."""
+    from sparkdl_tpu.core import telemetry
+    from sparkdl_tpu.core.telemetry import Telemetry
+
+    mf = ModelFunction(lambda vs, x: x * vs, jnp.asarray(2.0),
+                       TensorSpec((None, 3)), name="compile_span")
+    with Telemetry() as tel:
+        mf.apply_batch(np.ones((4, 3), np.float32), batch_size=8)
+        mf.apply_batch(np.ones((4, 3), np.float32), batch_size=8)
+        mf.apply_batch(np.ones((12, 3), np.float32), batch_size=8)
+    compiles = tel.tracer.spans(telemetry.SPAN_COMPILE)
+    # bucket 8 compiles once (second call is a repeat); the 12-row call
+    # adds buckets 8 (seen) + the tail bucket only if it differs — with
+    # batch_size 8 the chunks are 8 and a 4-row tail at bucket 8, both
+    # seen, so exactly ONE compile span total
+    assert len(compiles) == 1
+    assert compiles[0]["attributes"]["model"] == "compile_span"
+
+
+def test_compile_cache_env_configures_jax(tmp_path, monkeypatch):
+    """ISSUE 5 satellite: SPARKDL_COMPILE_CACHE_DIR wires jax's persistent
+    compilation cache at package init."""
+    import sparkdl_tpu
+
+    prev = jax.config.jax_compilation_cache_dir
+    try:
+        monkeypatch.delenv(sparkdl_tpu.COMPILE_CACHE_DIR_ENV, raising=False)
+        assert sparkdl_tpu._configure_compile_cache() is False  # unset: no-op
+        target = str(tmp_path / "xla_cache")
+        monkeypatch.setenv(sparkdl_tpu.COMPILE_CACHE_DIR_ENV, target)
+        assert sparkdl_tpu._configure_compile_cache() is True
+        assert jax.config.jax_compilation_cache_dir == target
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)

@@ -517,3 +517,31 @@ def test_sharded_stream_quarantine_degrades_only_owning_host():
              for b in df.streamPartitions(process_id=1, num_processes=2)]
     assert host0 == [[0, 1, 2], [6, 7, 8]]  # untouched
     assert host1 == [[], [9, 10, 11]]  # partition 1 dropped, order kept
+
+
+def test_retry_loop_attempt_restarts_executor_call_sequence():
+    """Each retry-loop attempt re-runs the op chain from the top, so its
+    device calls restart at call 0 — run_partition_task must realign the
+    executor's hedge-dedup sequence per attempt, or a retried primary's
+    call 0 would sit at seq N and a hedge's call N could cross-dedup onto
+    the wrong device call's output (core/executor.py)."""
+    from sparkdl_tpu.core.executor import current_task_token, task_scope
+
+    seen = []
+    failures = {"n": 1}
+
+    def device_call(batch):
+        seen.append(current_task_token())
+        if failures["n"] > 0:
+            failures["n"] -= 1
+            raise RuntimeError("UNAVAILABLE: transient")
+        return batch
+
+    with task_scope(("task", 7, 0)):
+        out = run_partition_task(0, "rows", [device_call, device_call],
+                                 FAST)
+    assert out == "rows"
+    # attempt 0: call 0 raised; attempt 1: calls 0 and 1 — the retried
+    # attempt's sequence restarted at 0 instead of continuing at 1
+    assert seen == [("task", 7, 0, 0), ("task", 7, 0, 0),
+                    ("task", 7, 0, 1)]

@@ -40,7 +40,8 @@ def _restore_engine_config():
     saved = {k: getattr(EngineConfig, k) for k in (
         "task_timeout_s", "speculation", "speculation_quantile",
         "speculation_min_runtime_s", "quarantine", "quarantine_max_fatal",
-        "max_task_retries", "max_workers")}
+        "max_task_retries", "max_workers", "coalesce",
+        "coalesce_window_ms", "coalesce_max_rows")}
     yield
     for k, v in saved.items():
         setattr(EngineConfig, k, v)
@@ -258,6 +259,44 @@ def test_chaos_run_under_telemetry_scope_produces_run_report(image_dir,
     assert len(complete) == len(spans)
     assert len({e["tid"] for e in complete}) >= 3
     assert all(e["ts"] >= 0 and e["dur"] >= 0 for e in complete)
+
+
+def test_chaos_coalesced_transform_matches_plain_under_faults(image_dir):
+    """ISSUE 5 satellite: seeded device_oom + task_stall under
+    EngineConfig.coalesce=True yield bit-identical outputs and health
+    counts equal to the non-coalesced run (the execution service is
+    observationally transparent, faults included)."""
+    t = TPUImageTransformer(inputCol="image", outputCol="features",
+                            modelFunction=_feature_model(), batchSize=8,
+                            outputMode="vector")
+
+    def run(coalesce):
+        EngineConfig.coalesce = coalesce
+        inj = FaultInjector.seeded(
+            0,
+            # fires on the first ≥3-valid-row launch, whichever side
+            # (coalesced super-batch or per-partition chunk) gets there
+            # first — each partition stages 3 valid rows, so it fires in
+            # both modes exactly once
+            device_oom=Fault(times=1,
+                             when=lambda c: c.get("valid", 0) >= 3),
+            # partition 2's first task attempt hangs briefly; with no
+            # deadline armed the stall surfaces retryable and the task
+            # retry heals it
+            task_stall=Fault(times=1,
+                             when=lambda c: c["partition"] == 2))
+        with inj, HealthMonitor() as mon:
+            df = imageIO.readImages(str(image_dir), numPartition=4)
+            rows = t.transform(df).select("features").collect()
+        assert inj.fired == {"device_oom": 1, "task_stall": 1}
+        return rows, mon.report()["counters"]
+
+    rows_plain, health_plain = run(coalesce=False)
+    rows_coalesced, health_coalesced = run(coalesce=True)
+    assert rows_coalesced == rows_plain  # bit-identical, order-preserving
+    assert health_coalesced == health_plain
+    assert health_plain[health.OOM_RECHUNK] == 1
+    assert health_plain[health.TASK_RETRIED] == 1
 
 
 def test_chaos_fatal_transform_error_retried_zero_times(image_dir):

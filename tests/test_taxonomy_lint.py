@@ -303,3 +303,64 @@ def test_span_name_lint_catches_typo_and_resolves_constants():
     assert "sparkdl.train_stepp" not in _telemetry.CANONICAL_SPAN_NAMES
     assert all(n in _telemetry.CANONICAL_SPAN_NAMES
                for n in resolved if n != "sparkdl.train_stepp")
+
+
+# ---------------------------------------------------------------------------
+# Executor choke-point lint (ISSUE 5): the inference data plane's device
+# entry goes through core/executor.py's `execute` — the coalescing choke
+# point. A transformer (or UDF, or engine op) calling `apply_batch` /
+# `jitted` directly would silently regress the featurize route back to
+# per-partition launches, invisible until the next bench round. Only the
+# choke point itself and the model layer it wraps may touch those
+# methods; training (train/) owns its own step programs and is exempt.
+# ---------------------------------------------------------------------------
+
+_DEVICE_ENTRY_ATTRS = {"apply_batch", "jitted"}
+# The featurize/serving route that MUST go through the executor. The
+# choke point itself (core/executor.py) and the model layer it delegates
+# to (core/model_function.py) live outside these scopes by design; the
+# training path (train/) owns its own step programs and is exempt.
+_CHOKE_SCOPES = ("ml", "udf", "engine", "image")
+
+
+def _direct_device_entry_calls(tree: ast.AST):
+    """Lines of direct `<obj>.apply_batch(...)` / `<obj>.jitted(...)`
+    calls in the tree."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in _DEVICE_ENTRY_ATTRS:
+            out.append(node.lineno)
+    return sorted(out)
+
+
+def test_featurize_route_enters_device_via_executor_choke_point():
+    offenders = []
+    for scope in _CHOKE_SCOPES:
+        for path in sorted((ROOT / scope).rglob("*.py")):
+            tree = ast.parse(path.read_text(), filename=str(path))
+            offenders.extend(
+                f"{path.relative_to(ROOT.parent)}:{line}"
+                for line in _direct_device_entry_calls(tree))
+    assert not offenders, (
+        "direct apply_batch/jitted call on the engine featurize route — "
+        "device entry must go through core.executor.execute (the "
+        "coalescing choke point), or concurrent partitions silently "
+        "regress to per-partition launches (docs/PERF.md "
+        "'Cross-partition coalescing'): "
+        f"{offenders}")
+
+
+def test_choke_point_lint_catches_direct_apply_batch():
+    """Self-test: the pre-executor transformer shape (calling the model's
+    apply_batch / jitted straight from the partition op) must trip."""
+    bad = (
+        "def apply_partition(batch):\n"
+        "    out = model.apply_batch(stacked, batch_size=64)\n"
+        "    fn = model.jitted(mesh=mesh)\n"
+        "    good = device_executor.execute(model, stacked)\n"
+        "    return out\n"
+    )
+    assert _direct_device_entry_calls(ast.parse(bad)) == [2, 3]
