@@ -313,6 +313,107 @@ def bench_concurrent_featurize(name="EfficientNetB0", n_images=256,
     return (ips_on, sp_on, mfu, ips_off, sp_off, tel_summary)
 
 
+def bench_overload_featurize(name="EfficientNetB0", n_bulk=192,
+                             bulk_partitions=8, n_interactive=24,
+                             interactive_partitions=2, size=(224, 224)):
+    """ISSUE 6 satellite: burst-submit concurrent featurize partitions
+    past the executor queue bound (docs/RESILIENCE.md "Overload &
+    graceful degradation").
+
+    Two transformers share ONE ModelFunction (same compiled fn = same
+    executor queue): a wide bulk flood plus a small interactive job,
+    racing on separate threads. Shedding ON pins tiny queue caps in shed
+    mode — the engine's classified retry absorbs the ExecutorOverloaded
+    sheds — vs OFF (unbounded defaults) in one record, carrying the shed
+    rate, queue-wait p99, and the interactive-vs-bulk latency split that
+    shows the priority lanes protecting the small job under the flood."""
+    import threading
+
+    import pyarrow as pa
+
+    from sparkdl_tpu.core import executor as device_executor
+    from sparkdl_tpu.core import health, telemetry
+    from sparkdl_tpu.engine.dataframe import DataFrame, EngineConfig
+    from sparkdl_tpu.image import imageIO
+    from sparkdl_tpu.ml import TPUImageTransformer
+    from sparkdl_tpu.models import registry as model_registry
+
+    rng = np.random.default_rng(0)
+    schema = pa.schema([pa.field("image", imageIO.imageSchema)])
+
+    def frame(n, partitions):
+        rows = [{"image": imageIO.imageArrayToStruct(
+            rng.integers(0, 255, size=size + (3,), dtype=np.uint8))}
+            for _ in range(n)]
+        return DataFrame.fromRows(rows, schema=schema,
+                                  numPartitions=partitions)
+
+    df_bulk = frame(n_bulk, bulk_partitions)
+    df_int = frame(n_interactive, interactive_partitions)
+    mf = model_registry.build_featurizer(name, weights="random")
+    t_bulk = TPUImageTransformer(inputCol="image", outputCol="features",
+                                 modelFunction=mf,
+                                 batchSize=HEADLINE_BATCH)
+    t_int = TPUImageTransformer(inputCol="image", outputCol="features",
+                                modelFunction=mf, batchSize=HEADLINE_BATCH,
+                                priority="interactive")
+
+    def run_pair():
+        lat = {}
+
+        def one(key, t, df, n):
+            t0 = time.perf_counter()
+            out = t.transform(df).select("features").collect()
+            assert len(out) == n
+            lat[key] = time.perf_counter() - t0
+
+        threads = [
+            threading.Thread(target=one,
+                             args=("bulk", t_bulk, df_bulk, n_bulk)),
+            threading.Thread(target=one, args=("interactive", t_int,
+                                               df_int, n_interactive)),
+        ]
+        for th in threads:  # bulk first: the flood is queued when the
+            th.start()      # interactive job arrives
+        for th in threads:
+            th.join()
+        return lat
+
+    saved = EngineConfig.snapshot()
+    results = {}
+    try:
+        run_pair()  # warmup: compile + host caches, unbounded
+        for shed in (False, True):
+            if shed:
+                EngineConfig.executor_max_queued_requests = 2
+                EngineConfig.executor_overload_mode = "shed"
+                EngineConfig.max_task_retries = 50
+                EngineConfig.task_retry_delay_s = 0.01
+            EngineConfig.max_workers = (bulk_partitions
+                                        + interactive_partitions)
+            device_executor.reset()  # fresh queue/shed gauges per mode
+            with telemetry.Telemetry("bench_overload") as tel:
+                lat = run_pair()
+            snap = tel.metrics.snapshot()
+            results["shed_on" if shed else "shed_off"] = {
+                "interactive_s": round(lat["interactive"], 4),
+                "bulk_s": round(lat["bulk"], 4),
+                "sheds": snap["counters"].get(
+                    telemetry.HEALTH_METRIC_PREFIX + health.EXECUTOR_SHED,
+                    0),
+                "shed_rate": snap["gauges"].get(
+                    telemetry.M_EXECUTOR_SHED_RATE),
+                "queue_wait_s": _hist_summary(snap,
+                                              telemetry.M_QUEUE_WAIT_S),
+            }
+    finally:
+        EngineConfig.restore(saved)
+        device_executor.reset()
+    results["interactive_ips_shed_on"] = round(
+        n_interactive / results["shed_on"]["interactive_s"], 2)
+    return results
+
+
 def bench_batch_inference(name, n_images=256, size=(224, 224)):
     """Config 2: DeepImagePredictor over an in-memory image DataFrame."""
     import jax.numpy as jnp
@@ -524,6 +625,15 @@ def main():
                  coalesce_off_spread=round(csp_off, 4),
                  coalesce_speedup=round(cips / max(cips_off, 1e-9), 4),
                  telemetry=ctel)
+            # overload protection (ISSUE 6): burst past the executor
+            # queue bound — interactive-vs-bulk latency split and shed
+            # accounting, shedding on vs off in one record
+            ov = bench_overload_featurize()
+            emit("overload featurize interactive images/sec "
+                 "(EfficientNetB0 flood past queue bound, shed mode)",
+                 ov["interactive_ips_shed_on"], "images/sec",
+                 shed_on=ov["shed_on"], shed_off=ov["shed_off"])
+
             for name, size in (("ResNet50", (224, 224)),
                                ("Xception", (299, 299))):
                 ips, sp = bench_batch_inference(name, size=size)

@@ -60,7 +60,40 @@ Composition with the existing layers (the invariants tests pin down):
 
 Shutdown never leaks a future: :func:`shutdown` (and interpreter exit)
 fails every queued request with :class:`ExecutorShutdown`, so a worker
-blocked mid-window always completes or raises.
+blocked mid-window always completes or raises. Shutdown and
+:func:`reset` are idempotent and safe to race with concurrent submits —
+a submit that loses the race gets :class:`ExecutorShutdown`, never a
+hang or a leaked future.
+
+Overload protection (ISSUE 6, docs/RESILIENCE.md "Overload & graceful
+degradation") — every knob defaults to today's unbounded behavior:
+
+- **admission control**: ``EngineConfig.executor_max_queued_requests`` /
+  ``executor_max_queued_rows`` bound each compiled fn's queue. A submit
+  over the bound either *blocks* with backpressure (the default,
+  bounded by the caller's deadline) or — with
+  ``executor_overload_mode="shed"`` — fails immediately with
+  :class:`~sparkdl_tpu.core.resilience.ExecutorOverloaded`, which
+  classifies RETRYABLE so the engine's task retry absorbs the spike;
+- **deadline propagation**: the supervisor's per-task ``Deadline``
+  rides in ambiently (:class:`deadline_scope`); the coalescer drops
+  already-expired requests at drain time — before paying for a launch —
+  failing them with ``DeadlineExceeded`` (the same deadline-marked
+  taxonomy the watchdog uses, so the failure never quarantines and
+  never retries past the budget);
+- **priority lanes**: requests carry ``"interactive"`` or ``"bulk"``
+  (default bulk); the coalescer drains interactive first and — in shed
+  mode — an interactive arrival displaces the newest queued bulk
+  request rather than being shed itself, so batch featurize can never
+  starve online traffic;
+- **per-model circuit breaker**: ``executor_breaker_threshold`` terminal
+  launch failures within ``executor_breaker_window_s`` trip the
+  breaker; while open, submits fail fast with
+  :class:`~sparkdl_tpu.core.resilience.ExecutorCircuitOpen` (RETRYABLE
+  — backoff rides past ``executor_breaker_cooldown_s``, then a single
+  half-open probe re-tests the model and recovery reopens traffic).
+  Trip/probe/recover are health events + telemetry counters, and
+  queue-depth/shed-rate gauges join the executor metrics.
 """
 
 from __future__ import annotations
@@ -70,11 +103,17 @@ import threading
 import time
 from collections import deque
 from concurrent.futures import Future
+from contextlib import contextmanager
+from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from sparkdl_tpu.core import batching, health, resilience, telemetry
+from sparkdl_tpu.core.resilience import (  # noqa: F401 - re-exported API
+    ExecutorCircuitOpen,
+    ExecutorOverloaded,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -94,6 +133,41 @@ _IDLE_EXIT_S = 5.0
 
 class ExecutorShutdown(RuntimeError):
     """The execution service was shut down with this request still queued."""
+
+
+# Priority lanes: interactive drains first and is shed last. Bulk is the
+# default — batch featurize must OPT OUT of being sheddable, never the
+# other way around.
+PRIORITY_INTERACTIVE = "interactive"
+PRIORITY_BULK = "bulk"
+PRIORITIES = (PRIORITY_INTERACTIVE, PRIORITY_BULK)
+
+# Tick for the blocking-admission wait: short enough that a caller whose
+# deadline expires mid-wait notices promptly, long enough not to spin.
+_ADMIT_WAIT_TICK_S = 0.05
+
+
+@dataclass(frozen=True)
+class OverloadPolicy:
+    """Per-submit snapshot of the EngineConfig overload knobs (read once
+    in :func:`execute`, so a knob flip mid-run can't tear one request's
+    admission decision). All defaults mean "today's behavior": unbounded
+    queue, no shedding, breaker disabled."""
+
+    max_queued_requests: Optional[int] = None
+    max_queued_rows: Optional[int] = None
+    shed: bool = False          # False = block with backpressure
+    breaker_threshold: int = 0  # 0 disables the circuit breaker
+    breaker_window_s: float = 30.0
+    breaker_cooldown_s: float = 1.0
+
+    @property
+    def bounded(self) -> bool:
+        return (self.max_queued_requests is not None
+                or self.max_queued_rows is not None)
+
+
+_NO_OVERLOAD = OverloadPolicy()
 
 
 # ---------------------------------------------------------------------------
@@ -160,6 +234,37 @@ class task_scope:
         _tls.seq = self._prev_seq
 
 
+def current_deadline() -> Optional[resilience.Deadline]:
+    """The ambient task deadline for THIS thread's executor calls (set by
+    :class:`deadline_scope`; the supervisor enters one per task attempt).
+    None outside a scope."""
+    return getattr(_tls, "deadline", None)
+
+
+class deadline_scope:
+    """Thread the caller's :class:`~sparkdl_tpu.core.resilience.Deadline`
+    into every executor call made on this thread. ``run_partition_task``
+    wraps each task in one, so a queued request knows its budget: the
+    blocking admission wait is bounded by it, and the coalescer drops a
+    request whose deadline already expired at drain time — before paying
+    for a launch — instead of turning one slow window into a convoy of
+    doomed launches. A ``Deadline(None)`` (no budget) is not threaded:
+    the unloaded hot path stays free of per-request expiry checks."""
+
+    def __init__(self, deadline: Optional[resilience.Deadline]) -> None:
+        self._deadline = (deadline if deadline is not None
+                          and deadline.timeout_s is not None else None)
+        self._prev: Optional[resilience.Deadline] = None
+
+    def __enter__(self) -> "deadline_scope":
+        self._prev = getattr(_tls, "deadline", None)
+        _tls.deadline = self._deadline
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        _tls.deadline = self._prev
+
+
 # ---------------------------------------------------------------------------
 # Requests and per-compiled-fn state
 # ---------------------------------------------------------------------------
@@ -184,15 +289,30 @@ class _Request:
     the ON-DEVICE output slices back to the requester."""
 
     __slots__ = ("tree", "rows", "future", "token", "policy", "ctx",
-                 "t_enqueue", "launched")
+                 "t_enqueue", "launched", "priority", "deadline",
+                 "is_probe", "breaker_noted")
 
     def __init__(self, tree: Any, rows: int, token: Optional[Tuple],
-                 policy: resilience.RetryPolicy) -> None:
+                 policy: resilience.RetryPolicy,
+                 priority: str = PRIORITY_BULK,
+                 deadline: Optional[resilience.Deadline] = None) -> None:
         self.tree = tree
         self.rows = rows
         self.future: "Future[Any]" = Future()
         self.token = token
         self.policy = policy
+        self.priority = priority
+        self.deadline = deadline
+        # True when this request is the breaker's half-open probe: its
+        # outcome decides reopen-vs-close, and a probe that dies WITHOUT
+        # reaching the device must release the probe slot (never wedge
+        # the breaker half-open)
+        self.is_probe = False
+        # set-exception failures are breaker-counted ONCE per request —
+        # a plumbing failure fanned out to a whole window, or two hedged
+        # waiters sharing one dedup'd future, must not multiply one
+        # launch failure into several breaker counts
+        self.breaker_noted = False
         self.ctx = telemetry.current_context()
         self.t_enqueue = time.monotonic()
         # set when the coalescer drains this request: dedup only shares
@@ -220,13 +340,23 @@ class _FnState:
         self.multiple = multiple
         self.cond = threading.Condition()
         self.pending: "deque[_Request]" = deque()
+        self.pending_rows = 0       # incremental sum(r.rows for pending)
+        self.pending_deadlines = 0  # queued requests carrying a deadline
         self.dedup: Dict[Tuple, _Request] = {}
         self.inflight = 0           # launches running (inline + coalesced)
         self.window_s: Optional[float] = None  # None = adaptive
         self.cap = batch_size
+        self.overload: OverloadPolicy = _NO_OVERLOAD
         self.latency_ewma: Optional[float] = None
         self.thread: Optional[threading.Thread] = None
         self.last_used = time.monotonic()
+        # Circuit breaker (closed -> open -> half_open -> closed); all
+        # guarded by cond. breaker_failures holds terminal-failure
+        # timestamps inside the rolling window.
+        self.breaker_state = "closed"
+        self.breaker_failures: "deque[float]" = deque()
+        self.breaker_opened_at = 0.0
+        self.breaker_probe_inflight = False
 
     def effective_window(self) -> float:
         if self.window_s is not None:
@@ -250,65 +380,138 @@ class DeviceExecutor:
         self._lock = threading.Lock()
         self._states: Dict[Tuple, _FnState] = {}
         self._closed = False
+        self._shutdown_complete = False  # idempotent-shutdown fast path
         self._thread_seq = 0
         self._inflight_total = 0  # O(1) occupancy counter (gauge source)
+        self._queued_total = 0    # O(1) queue-depth counter (gauge source)
+        self._admitted = 0        # bounded-admission accounting
+        self._shed = 0            # (shed-rate gauge = shed/(shed+admitted))
 
     # -- submission ----------------------------------------------------------
 
     def submit(self, model: Any, tree: Any, rows: int, batch_size: int,
                mesh: Any, multiple: int, policy: resilience.RetryPolicy,
                window_s: Optional[float], cap: int,
-               prefetch: int) -> Any:
+               prefetch: int, *, priority: str = PRIORITY_BULK,
+               deadline: Optional[resilience.Deadline] = None,
+               overload: OverloadPolicy = _NO_OVERLOAD) -> Any:
         """Run ``rows`` staged rows through the model, coalescing with any
         concurrent sibling requests against the same compiled fn. Returns
-        host numpy (structure mirrors the model output). Blocking."""
+        host numpy (structure mirrors the model output). Blocking.
+
+        ``priority`` picks the lane (interactive drains first, bulk sheds
+        first); ``deadline`` bounds the blocking-admission wait and lets
+        the coalescer drop this request unlaunched once expired;
+        ``overload`` carries the admission/breaker knob snapshot."""
+        if priority not in PRIORITIES:
+            # a typo'd lane would queue into a lane the coalescer never
+            # drains — the caller would hang forever, not error
+            raise ValueError(
+                f"priority must be one of {PRIORITIES}, got {priority!r}")
         fn = model.jitted(mesh=mesh)
         state = self._state(fn, model, batch_size, mesh, multiple)
         token = current_task_token()
         t0 = time.monotonic()
         request: Optional[_Request] = None
         inline = False
+        is_probe = False
         with state.cond:
             if self._closed:
                 raise ExecutorShutdown("device execution service is shut "
                                        "down")
             state.window_s = window_s
             state.cap = cap
-            if token is not None:
-                dup = state.dedup.get(token)
-                if (dup is not None and dup.rows == rows
-                        and not dup.launched and not dup.future.done()):
-                    # hedged duplicate of a sibling attempt whose request
-                    # is still QUEUED: share its future — the rows
-                    # coalesce exactly once. An already-launched (or
-                    # inline) sibling is NOT shared: the hedge re-runs
-                    # the pure ops independently, so speculation can
-                    # still win past a launch stalled on the device.
-                    request = dup
-                    telemetry.count(telemetry.M_COALESCE_DEDUP)
-            if request is None:
-                if state.inflight == 0 and not state.pending:
-                    # solo under no contention: the existing inline path
-                    # on the caller's thread — zero added latency.
-                    # inflight is bumped first so siblings arriving
-                    # meanwhile queue up for the coalescer instead of
-                    # serializing behind us.
-                    state.inflight += 1
-                    self._note_inflight(1)
-                    inline = True
-                else:
-                    request = _Request(tree, rows, token, policy)
-                    state.pending.append(request)
-                    if token is not None:
-                        state.dedup[token] = request
-                    self._ensure_thread(state)
-                    state.cond.notify_all()
+            state.overload = overload
+            is_probe = self._breaker_admit_locked(state)
+            try:
+                if deadline is not None and deadline.expired():
+                    # never queue work that is already doomed; the
+                    # caller's cooperative deadline handling classifies
+                    # this exactly like an in-op expiry. Recorded under
+                    # the same event as a drain-time drop so the overload
+                    # accounting closes: every executor-raised
+                    # DeadlineExceeded is one EXECUTOR_DEADLINE_SHED.
+                    health.record(health.EXECUTOR_DEADLINE_SHED,
+                                  rows=rows, priority=priority,
+                                  at="admission")
+                    raise resilience.DeadlineExceeded(
+                        f"request expired before admission (deadline "
+                        f"{deadline.timeout_s}s)")
+                if token is not None:
+                    dup = state.dedup.get(token)
+                    if (dup is not None and dup.rows == rows
+                            and not dup.launched and not dup.future.done()):
+                        # hedged duplicate of a sibling attempt whose
+                        # request is still QUEUED: share its future — the
+                        # rows coalesce exactly once. An already-launched
+                        # (or inline) sibling is NOT shared: the hedge
+                        # re-runs the pure ops independently, so
+                        # speculation can still win past a launch stalled
+                        # on the device.
+                        request = dup
+                        if is_probe:
+                            # the shared request's outcome decides the
+                            # probe — mark it so _await releases the
+                            # probe slot on a never-launched death
+                            request.is_probe = True
+                        # the shared request lives as long as the LATEST
+                        # deadline among its waiters: a fresh hedge must
+                        # not be killed at drain time by the primary's
+                        # nearly-expired budget (hedging exists to rescue
+                        # exactly that straggler)
+                        if dup.deadline is not None:
+                            if deadline is None:
+                                dup.deadline = None
+                                state.pending_deadlines -= 1
+                            elif (deadline.remaining()
+                                    > dup.deadline.remaining()):
+                                dup.deadline = deadline
+                        telemetry.count(telemetry.M_COALESCE_DEDUP)
+                if request is None:
+                    if state.inflight == 0 and not state.pending:
+                        # solo under no contention: the existing inline
+                        # path on the caller's thread — zero added
+                        # latency. inflight is bumped first so siblings
+                        # arriving meanwhile queue up for the coalescer
+                        # instead of serializing behind us.
+                        state.inflight += 1
+                        self._note_inflight(1)
+                        if overload.bounded:
+                            self._note_admitted()
+                        inline = True
+                    else:
+                        if overload.bounded:
+                            self._admit_locked(state, rows, priority,
+                                               deadline)
+                            self._note_admitted()
+                        request = _Request(tree, rows, token, policy,
+                                           priority=priority,
+                                           deadline=deadline)
+                        request.is_probe = is_probe
+                        state.pending.append(request)
+                        state.pending_rows += rows
+                        if deadline is not None:
+                            state.pending_deadlines += 1
+                        self._note_queued(1)
+                        if token is not None:
+                            state.dedup[token] = request
+                        self._ensure_thread(state)
+                        state.cond.notify_all()
+            except BaseException:
+                # a probe that never reached the device (shed, expired,
+                # shutdown) must not wedge the breaker half-open: return
+                # it to half_open-with-no-probe so the next arrival
+                # probes instead of failing fast forever
+                if is_probe:
+                    state.breaker_probe_inflight = False
+                raise
         if not inline:
             return self._await(state, request, t0)
         try:
-            return model.apply_batch(tree, batch_size=batch_size,
-                                     mesh=mesh, retry_policy=policy,
-                                     prefetch=prefetch)
+            with self._breaker_observe(state, is_probe=is_probe):
+                return model.apply_batch(tree, batch_size=batch_size,
+                                         mesh=mesh, retry_policy=policy,
+                                         prefetch=prefetch)
         finally:
             with state.cond:
                 state.inflight -= 1
@@ -330,7 +533,17 @@ class DeviceExecutor:
         """
         import jax
 
-        out = request.future.result()  # isolated failures raise here
+        try:
+            out = request.future.result()  # isolated failures raise here
+        except BaseException as e:  # taxonomy-ok: breaker accounting, then re-raised
+            # once per REQUEST, not per waiter: two hedged waiters share
+            # one dedup'd future, and a launch-plumbing failure already
+            # noted (and marked) every window member in the coalescer
+            with state.cond:
+                noted, request.breaker_noted = request.breaker_noted, True
+            if not noted:
+                self._breaker_note(state, e, is_probe=request.is_probe)
+            raise
         if isinstance(out, _ReplayInline):
             # handed back by the coalescer (solo drained window, or a
             # terminal super-batch failure split): run the model's own
@@ -338,10 +551,12 @@ class DeviceExecutor:
             # retry and OOM bucket-halving apply per request, and the
             # coalescer thread stays free to drain siblings
             try:
-                return state.model.apply_batch(
-                    request.tree, batch_size=state.batch_size,
-                    mesh=state.mesh, retry_policy=request.policy,
-                    prefetch=0)
+                with self._breaker_observe(state,
+                                           is_probe=request.is_probe):
+                    return state.model.apply_batch(
+                        request.tree, batch_size=state.batch_size,
+                        mesh=state.mesh, retry_policy=request.policy,
+                        prefetch=0)
             finally:
                 with state.cond:
                     state.note_latency(time.monotonic() - t0)
@@ -356,9 +571,13 @@ class DeviceExecutor:
                 "coalesced result fetch failed (%s: %s; classified %s); "
                 "re-running the %d-row request alone", type(e).__name__,
                 e, kind, request.rows)
-            host = state.model.apply_batch(
-                request.tree, batch_size=state.batch_size,
-                mesh=state.mesh, retry_policy=request.policy, prefetch=0)
+            with self._breaker_observe(state, is_probe=request.is_probe,
+                                       note_success=False):
+                host = state.model.apply_batch(
+                    request.tree, batch_size=state.batch_size,
+                    mesh=state.mesh, retry_policy=request.policy,
+                    prefetch=0)
+        self._breaker_note(state, None, is_probe=request.is_probe)
         with state.cond:
             state.note_latency(time.monotonic() - t0)
         return host
@@ -427,6 +646,245 @@ class DeviceExecutor:
         if telemetry.active() is not None:
             telemetry.gauge_set(telemetry.M_EXECUTOR_OCCUPANCY, total)
 
+    def _note_queued(self, delta: int) -> None:
+        """O(1) process-wide queued-request accounting (queue-depth gauge)."""
+        with self._lock:
+            self._queued_total += delta
+            total = self._queued_total
+        if telemetry.active() is not None:
+            telemetry.gauge_set(telemetry.M_EXECUTOR_QUEUE_DEPTH, total)
+
+    def _note_admitted(self) -> None:
+        with self._lock:
+            self._admitted += 1
+        self._note_shed_rate()
+
+    def _note_shed(self, rows: int, priority: str, reason: str) -> None:
+        with self._lock:
+            self._shed += 1
+        health.record(health.EXECUTOR_SHED, rows=rows, priority=priority,
+                      reason=reason)
+        self._note_shed_rate()
+
+    def _note_shed_rate(self) -> None:
+        if telemetry.active() is None:
+            return
+        with self._lock:
+            admitted, shed = self._admitted, self._shed
+        if admitted + shed:
+            telemetry.gauge_set(telemetry.M_EXECUTOR_SHED_RATE,
+                                shed / (admitted + shed))
+
+    # -- admission control ----------------------------------------------------
+
+    def _admit_locked(self, state: _FnState, rows: int, priority: str,
+                      deadline: Optional[resilience.Deadline]) -> None:
+        """Enforce the per-fn queue bound (caller holds state.cond).
+
+        Over the bound, shed mode fails fast (interactive first displaces
+        the newest queued bulk request — bulk sheds before interactive);
+        block mode waits with backpressure, bounded by the caller's
+        deadline and woken by every coalescer drain. An empty queue
+        always admits: a bound smaller than one request must not wedge."""
+        ov = state.overload
+
+        def over() -> bool:
+            if not state.pending:
+                return False
+            if (ov.max_queued_requests is not None
+                    and len(state.pending) >= ov.max_queued_requests):
+                return True
+            return (ov.max_queued_rows is not None
+                    and state.pending_rows + rows > ov.max_queued_rows)
+
+        while over():
+            if ov.shed:
+                if (priority == PRIORITY_INTERACTIVE
+                        and self._evict_bulk_locked(state)):
+                    continue  # re-check: the eviction may have made room
+                self._note_shed(rows, priority, reason="admission")
+                raise ExecutorOverloaded(
+                    f"executor queue for {getattr(state.model, 'name', '?')} "
+                    f"is full ({len(state.pending)} request(s), "
+                    f"{state.pending_rows} row(s) queued); {rows}-row "
+                    f"{priority} request shed")
+            # block with backpressure: bounded by the caller's deadline
+            if self._closed:
+                raise ExecutorShutdown(
+                    "device execution service shut down while this "
+                    "request waited for admission")
+            timeout = _ADMIT_WAIT_TICK_S
+            if deadline is not None:
+                remaining = deadline.remaining()
+                if remaining <= 0:
+                    health.record(health.EXECUTOR_DEADLINE_SHED,
+                                  rows=rows, priority=priority,
+                                  at="backpressure")
+                    raise resilience.DeadlineExceeded(
+                        f"request deadline ({deadline.timeout_s}s) expired "
+                        "while blocked on executor admission")
+                timeout = min(timeout, remaining)
+            state.cond.wait(timeout=timeout)
+            if self._closed:
+                raise ExecutorShutdown(
+                    "device execution service shut down while this "
+                    "request waited for admission")
+
+    def _evict_bulk_locked(self, state: _FnState) -> bool:
+        """Shed the NEWEST queued bulk request to make room for an
+        interactive arrival (caller holds state.cond). Newest-first keeps
+        the displaced work's retry cheapest: it waited least, so the
+        least queue progress is thrown away. Returns True if one was
+        evicted."""
+        for r in reversed(state.pending):
+            if r.priority != PRIORITY_BULK or r.future.done():
+                continue
+            state.pending.remove(r)
+            state.pending_rows -= r.rows
+            if r.deadline is not None:
+                state.pending_deadlines -= 1
+            if r.token is not None and state.dedup.get(r.token) is r:
+                del state.dedup[r.token]
+            self._note_queued(-1)
+            self._note_shed(r.rows, r.priority, reason="displaced")
+            r.future.set_exception(ExecutorOverloaded(
+                f"{r.rows}-row bulk request displaced from the full "
+                f"executor queue by an interactive arrival"))
+            return True
+        return False
+
+    # -- per-model circuit breaker --------------------------------------------
+
+    def _breaker_admit_locked(self, state: _FnState) -> bool:
+        """Gate a submit on the breaker state (caller holds state.cond).
+        Returns True when THIS request is the half-open probe. Raises
+        :class:`ExecutorCircuitOpen` (RETRYABLE) while open or while a
+        probe is already in flight."""
+        ov = state.overload
+        if ov.breaker_threshold <= 0 or state.breaker_state == "closed":
+            return False
+        name = getattr(state.model, "name", "?")
+        if state.breaker_state == "open":
+            if (time.monotonic() - state.breaker_opened_at
+                    < ov.breaker_cooldown_s):
+                raise ExecutorCircuitOpen(
+                    f"circuit breaker for model {name!r} is open "
+                    f"({ov.breaker_threshold} terminal launch failure(s) "
+                    f"within {ov.breaker_window_s}s); failing fast for "
+                    f"{ov.breaker_cooldown_s}s")
+            state.breaker_state = "half_open"
+            state.breaker_probe_inflight = True
+            health.record(health.BREAKER_PROBE, model=name)
+            logger.warning(
+                "circuit breaker for model %r half-open after %.2fs "
+                "cooldown; admitting one probe request", name,
+                ov.breaker_cooldown_s)
+            return True
+        # half_open: exactly one probe at a time
+        if state.breaker_probe_inflight:
+            raise ExecutorCircuitOpen(
+                f"circuit breaker for model {name!r} is half-open with a "
+                "probe in flight; failing fast")
+        state.breaker_probe_inflight = True
+        health.record(health.BREAKER_PROBE, model=name)
+        return True
+
+    @contextmanager
+    def _breaker_observe(self, state: _FnState, *, is_probe: bool = False,
+                         note_success: bool = True):
+        """The single home for launch-outcome breaker accounting: feed
+        the wrapped block's exception (re-raised) or success into
+        :meth:`_breaker_note`. ``note_success=False`` for blocks whose
+        success is noted later on a shared exit path (``_await``'s fetch
+        chain ends in one success note)."""
+        try:
+            yield
+        except BaseException as e:  # taxonomy-ok: breaker accounting, then re-raised
+            self._breaker_note(state, e, is_probe=is_probe)
+            raise
+        else:
+            if note_success:
+                self._breaker_note(state, None, is_probe=is_probe)
+
+    def _breaker_note(self, state: _FnState,
+                      error: Optional[BaseException], *,
+                      is_probe: bool = False) -> None:
+        """Feed one terminal launch outcome into the breaker. Failures
+        that never reached the device (shed, shutdown, fast-fail,
+        deadline — slowness, not poison) do not count — but a PROBE that
+        dies that way must still release the probe slot (back to
+        half-open-with-no-probe, so the next arrival probes), or the
+        breaker would wedge half-open and fail fast forever."""
+        if state.overload.breaker_threshold <= 0 and not is_probe:
+            return  # breaker disabled: no lock on the hot path
+        if isinstance(error, (ExecutorShutdown, ExecutorOverloaded,
+                              ExecutorCircuitOpen,
+                              resilience.DeadlineExceeded)):
+            if is_probe:
+                with state.cond:
+                    if state.breaker_state == "half_open":
+                        state.breaker_probe_inflight = False
+            return
+        with state.cond:
+            ov = state.overload
+            if ov.breaker_threshold <= 0:
+                # knobs flipped to disabled mid-flight: still release a
+                # probe slot so a later re-enable can't find it wedged
+                if is_probe and state.breaker_state == "half_open":
+                    state.breaker_probe_inflight = False
+                return
+            name = getattr(state.model, "name", "?")
+            now = time.monotonic()
+            if state.breaker_state == "half_open":
+                if not is_probe:
+                    # a stale pre-trip launch resolving late must not
+                    # decide the probe's verdict ("exactly one probe; ITS
+                    # outcome decides"): a stale failure joins the
+                    # rolling window (cleared on recovery), a stale
+                    # success is ignored
+                    if error is not None:
+                        state.breaker_failures.append(now)
+                    return
+                state.breaker_probe_inflight = False
+                if error is None:
+                    state.breaker_state = "closed"
+                    state.breaker_failures.clear()
+                    health.record(health.BREAKER_CLOSED, model=name)
+                    logger.warning(
+                        "circuit breaker for model %r closed: probe "
+                        "launch succeeded", name)
+                else:
+                    state.breaker_state = "open"
+                    state.breaker_opened_at = now
+                    health.record(health.BREAKER_OPEN, model=name,
+                                  probe=True, error=type(error).__name__)
+                    logger.warning(
+                        "circuit breaker for model %r re-opened: probe "
+                        "failed (%s: %s)", name, type(error).__name__,
+                        error)
+                return
+            if error is None or state.breaker_state == "open":
+                return
+            # closed + terminal failure: count within the rolling window
+            state.breaker_failures.append(now)
+            cutoff = now - ov.breaker_window_s
+            while (state.breaker_failures
+                    and state.breaker_failures[0] < cutoff):
+                state.breaker_failures.popleft()
+            if len(state.breaker_failures) >= ov.breaker_threshold:
+                state.breaker_state = "open"
+                state.breaker_opened_at = now
+                state.breaker_probe_inflight = False
+                health.record(health.BREAKER_OPEN, model=name,
+                              failures=len(state.breaker_failures),
+                              error=type(error).__name__)
+                logger.error(
+                    "circuit breaker for model %r OPEN: %d terminal "
+                    "launch failure(s) within %.1fs (last: %s: %s); "
+                    "failing fast for %.2fs", name,
+                    len(state.breaker_failures), ov.breaker_window_s,
+                    type(error).__name__, error, ov.breaker_cooldown_s)
+
     # -- the coalescer -------------------------------------------------------
 
     def _coalesce_loop(self, state: _FnState) -> None:
@@ -462,25 +920,80 @@ class DeviceExecutor:
                     deadline = (state.pending[0].t_enqueue
                                 + state.effective_window())
                     while not self._closed:
-                        total = sum(r.rows for r in state.pending)
                         remaining = deadline - time.monotonic()
-                        if remaining <= 0 or total >= state.cap:
+                        if remaining <= 0 or state.pending_rows >= state.cap:
                             break
+                        if state.pending_deadlines:
+                            # the earliest queued request deadline caps
+                            # the wait: a doomed request triggers a drain
+                            # (which drops it) the moment it expires,
+                            # instead of blocking its caller for the
+                            # remainder of a possibly much longer window
+                            for r in state.pending:
+                                if r.deadline is not None:
+                                    remaining = min(remaining,
+                                                    r.deadline.remaining())
+                            if remaining <= 0:
+                                break
                         state.cond.wait(timeout=remaining)
                     if self._closed:
                         crashed = False
                         return
                     batch: List[_Request] = []
+                    expired: List[_Request] = []
                     total = 0
-                    while state.pending:
-                        nxt = state.pending[0]
-                        if batch and total + nxt.rows > state.cap:
-                            break  # leave the rest for the next round
-                        nxt.launched = True  # past dedup's sharing window
-                        batch.append(state.pending.popleft())
-                        total += nxt.rows
-                    state.inflight += 1
-                    self._note_inflight(1)
+                    # ONE O(n) pass: drop already-expired requests BEFORE
+                    # paying for a launch (an overloaded queue must not
+                    # turn one slow window into a convoy of doomed
+                    # launches) and partition survivors into lanes —
+                    # never per-item deque.remove(), which would make a
+                    # deep drain O(n^2) exactly when the queue is deep
+                    lanes: Dict[str, List[_Request]] = \
+                        {p: [] for p in PRIORITIES}
+                    for r in state.pending:
+                        if r.deadline is not None and r.deadline.expired():
+                            if (r.token is not None
+                                    and state.dedup.get(r.token) is r):
+                                del state.dedup[r.token]
+                            expired.append(r)
+                        else:
+                            lanes[r.priority].append(r)
+                    # interactive lane drains first, FIFO within a lane;
+                    # the first over-cap request (and everything behind
+                    # it) waits for the next round
+                    overflow = False
+                    for lane in PRIORITIES:
+                        if overflow:
+                            break
+                        for r in lanes[lane]:
+                            if batch and total + r.rows > state.cap:
+                                overflow = True
+                                break
+                            r.launched = True  # past dedup sharing window
+                            batch.append(r)
+                            total += r.rows
+                    if batch or expired:
+                        dropped = {id(r) for r in batch}
+                        dropped.update(id(r) for r in expired)
+                        # rebuild preserves arrival order for leftovers
+                        state.pending = deque(
+                            r for r in state.pending
+                            if id(r) not in dropped)
+                        state.pending_rows -= (
+                            total + sum(r.rows for r in expired))
+                        state.pending_deadlines = sum(
+                            1 for r in state.pending
+                            if r.deadline is not None)
+                        self._note_queued(-(len(batch) + len(expired)))
+                        # blocked admission waiters: room just freed
+                        state.cond.notify_all()
+                    if batch:
+                        state.inflight += 1
+                        self._note_inflight(1)
+                if expired:
+                    self._fail_expired(expired)
+                if not batch:
+                    continue  # the whole window expired unlaunched
                 try:
                     self._launch(state, batch, total)
                 except BaseException as e:  # taxonomy-ok: not a retry — the error is delivered to every drained future
@@ -491,6 +1004,15 @@ class DeviceExecutor:
                     logger.exception(
                         "coalescer launch plumbing failed; delivering the "
                         "error to all %d drained request(s)", len(batch))
+                    # ONE failed launch = ONE breaker count, however many
+                    # requests the window held; mark every member so the
+                    # waiters' fetch-side accounting doesn't re-count it
+                    with state.cond:
+                        for r in batch:
+                            r.breaker_noted = True
+                    self._breaker_note(
+                        state, e,
+                        is_probe=any(r.is_probe for r in batch))
                     for r in batch:
                         if not r.future.done():
                             r.future.set_exception(e)
@@ -510,13 +1032,32 @@ class DeviceExecutor:
                                        "down with this request still "
                                        "queued"))
 
+    def _fail_expired(self, expired: List[_Request]) -> None:
+        """Deliver the deadline-shed outcome: the same deadline-marked
+        taxonomy the supervisor's watchdog uses (``DeadlineExceeded`` →
+        FATAL, never retried past the budget, never quarantined)."""
+        for r in expired:
+            health.record(health.EXECUTOR_DEADLINE_SHED, rows=r.rows,
+                          priority=r.priority,
+                          queued_s=round(time.monotonic() - r.t_enqueue, 4))
+            if not r.future.done():
+                r.future.set_exception(resilience.DeadlineExceeded(
+                    f"{r.rows}-row request expired in the executor queue "
+                    f"(deadline {r.deadline.timeout_s}s); dropped before "
+                    "launch"))
+
     def _fail_pending(self, state: _FnState, error: BaseException) -> None:
         with state.cond:
             pending = list(state.pending)
             state.pending.clear()
+            state.pending_rows = 0
+            state.pending_deadlines = 0
             state.dedup.clear()
             if state.thread is threading.current_thread():
                 state.thread = None
+            state.cond.notify_all()  # blocked admission waiters re-check
+        if pending:
+            self._note_queued(-len(pending))
         for r in pending:
             if not r.future.done():
                 r.future.set_exception(error)
@@ -639,8 +1180,17 @@ class DeviceExecutor:
     def shutdown(self) -> None:
         """Stop every coalescer thread; fail every queued request with
         :class:`ExecutorShutdown`. In-flight launches complete. No future
-        is ever left pending."""
+        is ever left pending.
+
+        Idempotent and safe to race with concurrent :meth:`submit` calls:
+        a second shutdown is a no-op, and a submit that loses the race
+        observes ``_closed`` under its state's cond (``_closed`` is
+        published under ``self._lock``, which every state lookup also
+        takes) and raises — a request can never be queued after its
+        state's pending sweep ran without the sweep seeing it."""
         with self._lock:
+            if self._shutdown_complete:
+                return  # double-shutdown: a no-op
             self._closed = True
             states = list(self._states.values())
         err = ExecutorShutdown("device execution service shut down with "
@@ -652,6 +1202,8 @@ class DeviceExecutor:
             if thread is not None and thread is not threading.current_thread():
                 thread.join(timeout=5.0)
             self._fail_pending(state, err)
+        with self._lock:
+            self._shutdown_complete = True
 
 
 # ---------------------------------------------------------------------------
@@ -684,7 +1236,9 @@ def reset() -> DeviceExecutor:
 def execute(model: Any, array: Any, *, batch_size: int = 64,
             mesh: Any = None,
             retry_policy: Optional[resilience.RetryPolicy] = None,
-            prefetch: int = 2, coalesce: Optional[bool] = None) -> Any:
+            prefetch: int = 2, coalesce: Optional[bool] = None,
+            priority: Optional[str] = None,
+            deadline: Optional[resilience.Deadline] = None) -> Any:
     """THE device entry point for the inference data plane.
 
     Transformers call this instead of ``model.apply_batch`` (enforced by
@@ -694,12 +1248,20 @@ def execute(model: Any, array: Any, *, batch_size: int = 64,
     coalescing service; everything else (and ``coalesce=False``) takes
     the existing ``apply_batch`` path unchanged. ``coalesce=None`` reads
     ``EngineConfig.coalesce``.
+
+    ``priority`` (``"interactive"``/``"bulk"``; ``None`` reads
+    ``EngineConfig.executor_default_priority``) picks the service lane;
+    ``deadline`` (``None`` adopts the ambient :class:`deadline_scope`
+    one, which the engine supervisor threads per task) bounds queue wait
+    and backpressure blocking. The admission/breaker knobs are read from
+    ``EngineConfig`` per call — see the module docstring.
     """
     # Lazy layering: core must stay importable without the engine, but the
     # coalescing knobs live with the other engine-wide knobs on
     # EngineConfig (the class tests already snapshot/restore).
     from sparkdl_tpu.engine.dataframe import EngineConfig
 
+    EngineConfig.validate()  # read-time knob validation (clear ValueError)
     if coalesce is None:
         coalesce = EngineConfig.coalesce
     if not coalesce:
@@ -724,5 +1286,23 @@ def execute(model: Any, array: Any, *, batch_size: int = 64,
     window_s = None if window_ms is None else max(0.0, window_ms / 1e3)
     policy = (retry_policy if retry_policy is not None
               else resilience.DEFAULT_INFERENCE_POLICY)
+    if (EngineConfig.executor_max_queued_requests is None
+            and EngineConfig.executor_max_queued_rows is None
+            and EngineConfig.executor_breaker_threshold <= 0):
+        overload = _NO_OVERLOAD  # defaults: no per-call allocation
+    else:
+        overload = OverloadPolicy(
+            max_queued_requests=EngineConfig.executor_max_queued_requests,
+            max_queued_rows=EngineConfig.executor_max_queued_rows,
+            shed=EngineConfig.executor_overload_mode == "shed",
+            breaker_threshold=EngineConfig.executor_breaker_threshold,
+            breaker_window_s=EngineConfig.executor_breaker_window_s,
+            breaker_cooldown_s=EngineConfig.executor_breaker_cooldown_s)
+    if priority is None:
+        priority = EngineConfig.executor_default_priority
+    if deadline is None:
+        deadline = current_deadline()
     return _service.submit(model, array, rows, batch_size, mesh, multiple,
-                           policy, window_s, cap, prefetch)
+                           policy, window_s, cap, prefetch,
+                           priority=priority, deadline=deadline,
+                           overload=overload)

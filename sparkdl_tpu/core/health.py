@@ -48,6 +48,12 @@ FIT_COMPLETED = "fit_completed"          # trainer: fit loop finished
 DECODE_DEGRADED = "decode_degraded"      # data plane: row degraded to null
 PREFETCH_REPORT = "prefetch_report"      # pipeline: per-stream staging summary
                                          # (staged/stalls/stall_s/max_depth)
+EXECUTOR_SHED = "executor_shed"          # executor: admission shed a request
+EXECUTOR_DEADLINE_SHED = "executor_deadline_shed"  # executor: request
+                                         # expired in queue, dropped pre-launch
+BREAKER_OPEN = "breaker_open"            # executor: circuit breaker tripped
+BREAKER_PROBE = "breaker_probe"          # executor: half-open probe admitted
+BREAKER_CLOSED = "breaker_closed"        # executor: probe succeeded, recovered
 
 
 class HealthMonitor:

@@ -90,6 +90,25 @@ class DeadlineExceeded(RuntimeError):
     """A :class:`Deadline` expired before the guarded work completed."""
 
 
+class ExecutorOverloaded(RuntimeError):
+    """The device execution service shed this request at admission (its
+    per-fn queue bound was exceeded in shed mode, or an interactive
+    arrival displaced this queued bulk request). RETRYABLE by
+    definition: overload is transient, and the engine's classified task
+    retry (``run_partition_task``) absorbs the spike with backoff.
+    Defined here (not in core.executor) so :func:`classify` stays the
+    single taxonomy source without an import cycle."""
+
+
+class ExecutorCircuitOpen(RuntimeError):
+    """The per-model circuit breaker is open: this model's recent
+    launches failed terminally, so the service fails fast instead of
+    queuing doomed work. RETRYABLE: the caller's bounded backoff rides
+    past the cooldown, after which a half-open probe re-tests the model
+    — if it healed, traffic flows again; if not, the retry budget
+    exhausts without ever paying for a queue slot or a launch."""
+
+
 # Exception types whose recurrence is deterministic: retrying replays the
 # same traceback. ValueError covers shape/dtype contract violations raised
 # throughout the framework; jax shape errors are TypeError subclasses.
@@ -140,7 +159,8 @@ def classify(err: BaseException) -> str:
         return kind
     if isinstance(err, DeviceOOM):
         return OOM
-    if isinstance(err, (Preemption, TransferStall)):
+    if isinstance(err, (Preemption, TransferStall, ExecutorOverloaded,
+                        ExecutorCircuitOpen)):
         return RETRYABLE
     if isinstance(err, DeadlineExceeded):
         return FATAL  # the deadline IS the retry budget; never retry past it

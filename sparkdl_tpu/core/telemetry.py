@@ -117,6 +117,11 @@ M_COALESCE_DEDUP = "sparkdl.executor.dedup_hits"       # counter (hedges)
 M_QUEUE_WAIT_S = "sparkdl.executor.queue_wait_s"       # histogram
 M_LAUNCH_S = "sparkdl.executor.launch_s"               # histogram (host)
 M_EXECUTOR_OCCUPANCY = "sparkdl.executor.occupancy"    # gauge (in-flight)
+# Overload protection (ISSUE 6): the shed/deadline/breaker COUNTS arrive
+# for free as sparkdl.health.* mirrors of the core/health.py events; the
+# gauges below are the executor's own instantaneous state.
+M_EXECUTOR_QUEUE_DEPTH = "sparkdl.executor.queue_depth"  # gauge (queued reqs)
+M_EXECUTOR_SHED_RATE = "sparkdl.executor.shed_rate"    # gauge (shed fraction)
 HEALTH_METRIC_PREFIX = "sparkdl.health."
 
 CANONICAL_METRIC_NAMES = frozenset({
@@ -125,6 +130,7 @@ CANONICAL_METRIC_NAMES = frozenset({
     M_BATCH_BUCKET_ROWS, M_PADDING_WASTE, M_ENGINE_ROWS_OUT,
     M_ENGINE_BYTES_OUT, M_COALESCE_REQUESTS, M_COALESCE_ROWS,
     M_COALESCE_DEDUP, M_QUEUE_WAIT_S, M_LAUNCH_S, M_EXECUTOR_OCCUPANCY,
+    M_EXECUTOR_QUEUE_DEPTH, M_EXECUTOR_SHED_RATE,
 })
 
 # ---------------------------------------------------------------------------

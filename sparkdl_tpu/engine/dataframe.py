@@ -86,6 +86,27 @@ class EngineConfig:
     coalesce_window_ms: Optional[float] = None
     # Row cap of one coalesced launch; None = the request's batch_size.
     coalesce_max_rows: Optional[int] = None
+    # -- executor overload protection (core/executor.py, docs/RESILIENCE.md
+    # "Overload & graceful degradation") ---------------------------------------
+    # Admission control: per-compiled-fn bounds on queued requests / queued
+    # rows. None (default) = unbounded — today's behavior.
+    executor_max_queued_requests: Optional[int] = None
+    executor_max_queued_rows: Optional[int] = None
+    # Over the bound: "block" (default) waits with backpressure, bounded by
+    # the caller's task deadline; "shed" fails fast with ExecutorOverloaded
+    # (classified RETRYABLE — the engine task retry absorbs the spike).
+    executor_overload_mode: str = "block"
+    # Priority lane for requests that don't say ("interactive" > "bulk"):
+    # interactive drains first and sheds last. Transformers override per
+    # instance via their `priority` param.
+    executor_default_priority: str = "bulk"
+    # Per-model circuit breaker: trip open after this many terminal launch
+    # failures within executor_breaker_window_s; fail fast for
+    # executor_breaker_cooldown_s, then admit one half-open probe. 0
+    # (default) disables the breaker entirely.
+    executor_breaker_threshold: int = 0
+    executor_breaker_window_s: float = 30.0
+    executor_breaker_cooldown_s: float = 1.0
     max_workers: int = max(2, (os.cpu_count() or 4) // 2)
     # DEPRECATED test hook (SURVEY.md §5.3 fault injection):
     # callable(partition_index, attempt) that may raise to simulate a task
@@ -93,6 +114,108 @@ class EngineConfig:
     # resilience.FaultInjector "engine_task" / "task_stall" points, which
     # share the injector's seeding story.
     fault_injector: Optional[Callable[[int, int], None]] = None
+
+    @classmethod
+    def snapshot(cls) -> Dict[str, Any]:
+        """Every public knob's current value — the ONE save/restore idiom
+        for fixtures and bench legs that mutate the class-wide config
+        (new knobs are covered without listing them). Callable knob
+        values (a set ``fault_injector``) are deliberately excluded, as
+        are the classmethods themselves."""
+        return {k: getattr(cls, k) for k in vars(cls)
+                if not k.startswith("_") and not callable(getattr(cls, k))}
+
+    @classmethod
+    def restore(cls, saved: Dict[str, Any]) -> None:
+        """Reapply a :meth:`snapshot`."""
+        for k, v in saved.items():
+            setattr(cls, k, v)
+
+    # last-validated knob values: validate() is called per device entry,
+    # so an unchanged config must cost one tuple build + compare, not the
+    # full check battery. Underscore-prefixed: excluded from the test
+    # fixtures' public-knob snapshots.
+    _validated_knobs: Optional[tuple] = None
+
+    @classmethod
+    def validate(cls) -> None:
+        """Validate every knob at READ time with a clear ``ValueError``
+        (instead of undefined downstream behavior: a negative timeout
+        silently expiring every task, a zero queue cap wedging admission,
+        an out-of-range quantile never hedging). Called by the knob
+        consumers — ``_supervisor_config`` per materialization and
+        ``core.executor.execute`` per device entry; memoized on the knob
+        values, so the per-entry cost of a steady config is one tuple
+        compare."""
+        knobs = (cls.max_task_retries, cls.task_retry_delay_s,
+                 cls.task_timeout_s, cls.speculation_quantile,
+                 cls.speculation_multiplier, cls.speculation_min_runtime_s,
+                 cls.quarantine_max_fatal, cls.coalesce_window_ms,
+                 cls.coalesce_max_rows, cls.executor_max_queued_requests,
+                 cls.executor_max_queued_rows, cls.executor_overload_mode,
+                 cls.executor_default_priority,
+                 cls.executor_breaker_threshold,
+                 cls.executor_breaker_window_s,
+                 cls.executor_breaker_cooldown_s, cls.max_workers)
+        if knobs == cls._validated_knobs:
+            return
+
+        def positive(name, value, allow_none=True, minimum=0.0,
+                     exclusive=True):
+            if value is None:
+                if not allow_none:
+                    raise ValueError(f"EngineConfig.{name} must be set")
+                return
+            bad = value <= minimum if exclusive else value < minimum
+            if bad:
+                op = ">" if exclusive else ">="
+                raise ValueError(
+                    f"EngineConfig.{name} must be {op} {minimum} (or "
+                    f"None), got {value!r}")
+
+        if cls.max_task_retries < 0:
+            raise ValueError("EngineConfig.max_task_retries must be >= 0, "
+                             f"got {cls.max_task_retries!r}")
+        positive("task_retry_delay_s", cls.task_retry_delay_s,
+                 exclusive=False)
+        positive("task_timeout_s", cls.task_timeout_s)
+        if not 0.0 <= cls.speculation_quantile <= 1.0:
+            raise ValueError(
+                "EngineConfig.speculation_quantile must be in [0, 1], "
+                f"got {cls.speculation_quantile!r}")
+        positive("speculation_multiplier", cls.speculation_multiplier)
+        positive("speculation_min_runtime_s", cls.speculation_min_runtime_s,
+                 exclusive=False)
+        if cls.quarantine_max_fatal < 1:
+            raise ValueError(
+                "EngineConfig.quarantine_max_fatal must be >= 1, got "
+                f"{cls.quarantine_max_fatal!r}")
+        positive("coalesce_window_ms", cls.coalesce_window_ms,
+                 exclusive=False)
+        positive("coalesce_max_rows", cls.coalesce_max_rows)
+        positive("executor_max_queued_requests",
+                 cls.executor_max_queued_requests)
+        positive("executor_max_queued_rows", cls.executor_max_queued_rows)
+        if cls.executor_overload_mode not in ("block", "shed"):
+            raise ValueError(
+                "EngineConfig.executor_overload_mode must be 'block' or "
+                f"'shed', got {cls.executor_overload_mode!r}")
+        if cls.executor_default_priority not in ("interactive", "bulk"):
+            raise ValueError(
+                "EngineConfig.executor_default_priority must be "
+                "'interactive' or 'bulk', got "
+                f"{cls.executor_default_priority!r}")
+        if cls.executor_breaker_threshold < 0:
+            raise ValueError(
+                "EngineConfig.executor_breaker_threshold must be >= 0 "
+                f"(0 disables), got {cls.executor_breaker_threshold!r}")
+        positive("executor_breaker_window_s", cls.executor_breaker_window_s)
+        positive("executor_breaker_cooldown_s",
+                 cls.executor_breaker_cooldown_s, exclusive=False)
+        if cls.max_workers < 1:
+            raise ValueError("EngineConfig.max_workers must be >= 1, got "
+                             f"{cls.max_workers!r}")
+        cls._validated_knobs = knobs
 
 
 def _task_policy() -> resilience.RetryPolicy:
@@ -104,6 +227,7 @@ def _task_policy() -> resilience.RetryPolicy:
 
 
 def _supervisor_config() -> SupervisorConfig:
+    EngineConfig.validate()  # read-time knob validation
     return SupervisorConfig(
         task_timeout_s=EngineConfig.task_timeout_s,
         speculation=EngineConfig.speculation,

@@ -163,8 +163,13 @@ def run_partition_task(index: int, batch: Any, ops: Sequence[Callable],
             # under the pool thread's sparkdl.task span, so a retried or
             # hedged task's attempts all share the task's trace); an
             # exception unwinding through it stamps an `error` attribute
+            # the task's Deadline rides into the device execution service
+            # ambiently (core/executor.py): a queued device request whose
+            # budget expires is dropped at drain time — before paying for
+            # a launch — and the blocking-admission wait is bounded by it
             with telemetry.span(telemetry.SPAN_TASK_ATTEMPT,
-                                partition=index, attempt=attempt):
+                                partition=index, attempt=attempt), \
+                    _executor.deadline_scope(deadline):
                 if legacy_injector is not None:
                     legacy_injector(index, attempt)
                 resilience.inject("engine_task", partition=index,

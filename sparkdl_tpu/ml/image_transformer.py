@@ -47,6 +47,7 @@ from sparkdl_tpu.param.shared_params import (
     HasModelFunction,
     HasOutputCol,
     HasOutputMode,
+    HasPriority,
 )
 
 OUTPUT_MODES = ("vector", "image")
@@ -58,7 +59,7 @@ _PREFETCH_DEPTH = 2
 
 class TPUImageTransformer(Transformer, HasInputCol, HasOutputCol,
                           HasModelFunction, HasOutputMode, HasBatchSize,
-                          HasMesh, ModelFunctionPersistence):
+                          HasMesh, HasPriority, ModelFunctionPersistence):
     """Apply a ModelFunction to an image-struct column.
 
     ``outputMode="vector"`` flattens model output per row into a fixed-size
@@ -80,7 +81,7 @@ class TPUImageTransformer(Transformer, HasInputCol, HasOutputCol,
                  outputMode: str = "vector",
                  batchSize: int = 64,
                  inputSize: Optional[Tuple[int, int]] = None,
-                 mesh=None) -> None:
+                 mesh=None, priority: Optional[str] = None) -> None:
         super().__init__()
         self._setDefault(outputMode="vector", batchSize=64, inputSize=None)
         kwargs = self._input_kwargs
@@ -93,7 +94,8 @@ class TPUImageTransformer(Transformer, HasInputCol, HasOutputCol,
                   outputMode: str = "vector",
                   batchSize: int = 64,
                   inputSize: Optional[Tuple[int, int]] = None,
-                  mesh=None) -> "TPUImageTransformer":
+                  mesh=None,
+                  priority: Optional[str] = None) -> "TPUImageTransformer":
         # outputMode validation lives in the param's typeConverter
         # (SparkDLTypeConverters.toOutputMode) so every set path is covered.
         return self._set(**self._input_kwargs)
@@ -133,6 +135,7 @@ class TPUImageTransformer(Transformer, HasInputCol, HasOutputCol,
 
         mesh = host_local_mesh(self.resolveMesh())
         target_size = self._target_size(model)
+        priority = self.getPriority()  # None: EngineConfig default lane
         run = model.flattened() if mode == "vector" else model
         if input_col not in dataset.columns:
             raise KeyError(f"No such column: {input_col!r}")
@@ -158,7 +161,8 @@ class TPUImageTransformer(Transformer, HasInputCol, HasOutputCol,
                     # launch when EngineConfig.coalesce is on
                     out = device_executor.execute(
                         run_fast, stacked, batch_size=batch_size,
-                        mesh=mesh, prefetch=_PREFETCH_DEPTH)
+                        mesh=mesh, prefetch=_PREFETCH_DEPTH,
+                        priority=priority)
                 if mode == "vector":
                     return _vectors_with_nulls(out, valid, batch.num_rows)
                 origins = col.field("origin").take(
@@ -193,7 +197,7 @@ class TPUImageTransformer(Transformer, HasInputCol, HasOutputCol,
                                     rows=len(stacked)):
                 out = device_executor.execute(
                     run, stacked, batch_size=batch_size, mesh=mesh,
-                    prefetch=_PREFETCH_DEPTH)
+                    prefetch=_PREFETCH_DEPTH, priority=priority)
             if mode == "vector":
                 return _vectors_with_nulls(out, valid, batch.num_rows)
             return _images_with_nulls(out, valid, batch.num_rows,

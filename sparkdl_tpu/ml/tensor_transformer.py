@@ -31,6 +31,7 @@ from sparkdl_tpu.param.shared_params import (
     HasMesh,
     HasModelFunction,
     HasOutputCol,
+    HasPriority,
 )
 
 
@@ -55,7 +56,7 @@ def column_to_block(column: pa.Array, element_shape) -> np.ndarray:
 
 
 class TPUTransformer(Transformer, HasInputCol, HasOutputCol,
-                     HasModelFunction, HasBatchSize, HasMesh,
+                     HasModelFunction, HasBatchSize, HasMesh, HasPriority,
                      ModelFunctionPersistence):
     """Apply a ModelFunction to numeric columns, emitting list<float32>.
 
@@ -85,7 +86,7 @@ class TPUTransformer(Transformer, HasInputCol, HasOutputCol,
                  outputMapping: Optional[dict] = None,
                  modelFunction=None,
                  batchSize: int = 64,
-                 mesh=None) -> None:
+                 mesh=None, priority: Optional[str] = None) -> None:
         super().__init__()
         self._setDefault(batchSize=64)
         kwargs = self._input_kwargs
@@ -98,7 +99,8 @@ class TPUTransformer(Transformer, HasInputCol, HasOutputCol,
                   outputMapping: Optional[dict] = None,
                   modelFunction=None,
                   batchSize: int = 64,
-                  mesh=None) -> "TPUTransformer":
+                  mesh=None,
+                  priority: Optional[str] = None) -> "TPUTransformer":
         return self._set(**self._input_kwargs)
 
     def setInputMapping(self, value: dict) -> "TPUTransformer":
@@ -134,6 +136,7 @@ class TPUTransformer(Transformer, HasInputCol, HasOutputCol,
 
         mesh = host_local_mesh(self.resolveMesh())
         element_shape = model.input_spec.element_shape
+        priority = self.getPriority()  # None: EngineConfig default lane
         if input_col not in dataset.columns:
             raise KeyError(f"No such column: {input_col!r}")
 
@@ -146,7 +149,8 @@ class TPUTransformer(Transformer, HasInputCol, HasOutputCol,
             # device entry via the execution-service choke point
             # (core/executor.py): concurrent partition chunks coalesce
             out = device_executor.execute(model, block,
-                                          batch_size=batch_size, mesh=mesh)
+                                          batch_size=batch_size, mesh=mesh,
+                                          priority=priority)
             out = np.asarray(out, dtype=np.float32).reshape(batch.num_rows, -1)
             return fixed_size_list_array(out).cast(pa.list_(pa.float32()))
 
@@ -182,6 +186,7 @@ class TPUTransformer(Transformer, HasInputCol, HasOutputCol,
         from sparkdl_tpu.core.mesh import host_local_mesh
 
         mesh = host_local_mesh(self.resolveMesh())
+        priority = self.getPriority()  # None: EngineConfig default lane
         out_cols = list(out_map.items())  # [(output-name, column)]
 
         def apply_partition(batch: pa.RecordBatch) -> pa.RecordBatch:
@@ -198,7 +203,8 @@ class TPUTransformer(Transformer, HasInputCol, HasOutputCol,
                 arr = batch.column(batch.schema.get_field_index(col))
                 blocks[input_name] = column_to_block(arr, spec.element_shape)
             outs = device_executor.execute(model, blocks,
-                                           batch_size=batch_size, mesh=mesh)
+                                           batch_size=batch_size, mesh=mesh,
+                                           priority=priority)
             if not isinstance(outs, dict):
                 raise ValueError(
                     "outputMapping requires the model to return a "

@@ -80,6 +80,32 @@ class HasBatchSize(Params):
         return self.getOrDefault(self.batchSize)
 
 
+class HasPriority(Params):
+    """Mixin: the device execution service's admission lane for this
+    component's requests (``core/executor.py`` overload protection,
+    docs/RESILIENCE.md "Overload & graceful degradation"): the coalescer
+    drains ``"interactive"`` requests first and sheds ``"bulk"`` first,
+    so batch featurize can never starve online traffic. ``None`` (unset)
+    falls back to ``EngineConfig.executor_default_priority``."""
+
+    priority = Param(
+        "HasPriority", "priority",
+        "executor admission lane: 'interactive' (drained first, shed "
+        "last) or 'bulk' (the batch default). None falls back to "
+        "EngineConfig.executor_default_priority",
+        typeConverter=SparkDLTypeConverters.toPriority)
+
+    def setPriority(self, value: Optional[str]) -> "HasPriority":
+        if value is None:
+            self.clear(self.priority)
+            return self
+        return self._set(priority=value)
+
+    def getPriority(self) -> Optional[str]:
+        return (self.getOrDefault(self.priority)
+                if self.isDefined(self.priority) else None)
+
+
 class HasMesh(Params):
     """Mixin: an optional ``jax.sharding.Mesh`` for multi-chip execution.
 

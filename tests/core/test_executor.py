@@ -26,15 +26,15 @@ _FEATURES = 3
 
 @pytest.fixture(autouse=True)
 def _fresh_executor():
-    """Each test gets its own service instance and pristine coalescing
-    knobs (EngineConfig is process-wide class state)."""
-    saved = {k: getattr(EngineConfig, k) for k in (
-        "coalesce", "coalesce_window_ms", "coalesce_max_rows")}
+    """Each test gets its own service instance and pristine knobs
+    (EngineConfig is process-wide class state; the snapshot covers every
+    public knob, so the ISSUE 6 overload knobs — and future ones — are
+    restored without listing them)."""
+    saved = EngineConfig.snapshot()
     executor.reset()
     yield
     executor.reset()
-    for k, v in saved.items():
-        setattr(EngineConfig, k, v)
+    EngineConfig.restore(saved)
 
 
 def _model(name="exec_model", sleep_s=0.0):

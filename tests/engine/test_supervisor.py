@@ -20,11 +20,9 @@ from sparkdl_tpu.core.resilience import (
 from sparkdl_tpu.engine import DataFrame, EngineConfig, TaskFailure
 from sparkdl_tpu.engine.supervisor import run_partition_task
 
-_DEFAULTS = {k: getattr(EngineConfig, k) for k in (
-    "max_task_retries", "task_retry_delay_s", "task_retry_policy",
-    "task_timeout_s", "speculation", "speculation_quantile",
-    "speculation_multiplier", "speculation_min_runtime_s", "quarantine",
-    "quarantine_max_fatal", "max_workers", "fault_injector")}
+# full snapshot of every public knob (ISSUE 6: the overload knobs — and
+# any future knob — are covered without listing them)
+_DEFAULTS = EngineConfig.snapshot()
 
 
 @pytest.fixture(autouse=True)

@@ -364,3 +364,89 @@ def test_choke_point_lint_catches_direct_apply_batch():
         "    return out\n"
     )
     assert _direct_device_entry_calls(ast.parse(bad)) == [2, 3]
+
+
+# ---------------------------------------------------------------------------
+# Health-event name lint (ISSUE 6): every `health.record(...)` call site in
+# sparkdl_tpu/ must pass a constant DECLARED in core/health.py as its event
+# name — a bare string would silently fork a counter (and escape the docs
+# catalog, the chaos accounting, and the sparkdl.health.* telemetry
+# mirrors) on the first typo.
+# ---------------------------------------------------------------------------
+
+from sparkdl_tpu.core import health as _health  # noqa: E402
+
+#: Event-name constants declared in core/health.py: UPPERCASE module
+#: attributes holding strings.
+_HEALTH_EVENT_CONSTANTS = {
+    name for name in vars(_health)
+    if name.isupper() and isinstance(getattr(_health, name), str)
+}
+
+
+def _bad_health_record_calls(tree: ast.AST):
+    """(lineno, reason) for every `health.record(...)` call whose event
+    argument is not a `health.<CONSTANT>` reference to a string constant
+    declared in core/health.py."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        # the framework-wide convention: `health.record(...)` on the
+        # imported module object (never `from ... import record`)
+        if not (isinstance(f, ast.Attribute) and f.attr == "record"
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "health"):
+            continue
+        if not node.args:
+            out.append((node.lineno, "no event argument"))
+            continue
+        arg = node.args[0]
+        if (isinstance(arg, ast.Constant) and isinstance(arg.value, str)):
+            out.append((node.lineno, f"bare string {arg.value!r}"))
+            continue
+        if not (isinstance(arg, ast.Attribute)
+                and isinstance(arg.value, ast.Name)
+                and arg.value.id == "health"):
+            out.append((node.lineno, "event name is not a "
+                                     "health.<CONSTANT> reference"))
+            continue
+        if arg.attr not in _HEALTH_EVENT_CONSTANTS:
+            out.append((node.lineno,
+                        f"health.{arg.attr} is not declared in "
+                        "core/health.py"))
+    return out
+
+
+def test_every_health_record_uses_a_declared_constant():
+    offenders = []
+    for path in sorted(ROOT.rglob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for line, reason in _bad_health_record_calls(tree):
+            offenders.append(
+                f"{path.relative_to(ROOT.parent)}:{line}: {reason}")
+    assert not offenders, (
+        "health.record() call site not using a constant declared in "
+        "core/health.py — a typo'd or ad-hoc event name silently forks a "
+        "counter outside the docs catalog and the telemetry mirror. "
+        f"Declare the event in core/health.py and reference it: {offenders}")
+
+
+def test_health_record_lint_catches_typos_and_bare_strings():
+    """Self-test: a bare string event, a typo'd constant, and a local
+    variable all trip; a declared constant passes."""
+    bad = (
+        "from sparkdl_tpu.core import health\n"
+        "health.record('task_retried', partition=1)\n"      # bare string
+        "health.record(health.TASK_RETIRED)\n"              # typo'd name
+        "health.record(evt, partition=1)\n"                 # dynamic name
+        "health.record(health.TASK_RETRIED, partition=1)\n"  # ok
+        "mon.record('whatever')\n"                          # not the hook
+    )
+    flagged = _bad_health_record_calls(ast.parse(bad))
+    assert [line for line, _ in flagged] == [2, 3, 4]
+    assert "TASK_RETIRED" in flagged[1][1]
+    # the constants set is non-trivial and holds the canonical events
+    assert "TASK_RETRIED" in _HEALTH_EVENT_CONSTANTS
+    assert "BREAKER_OPEN" in _HEALTH_EVENT_CONSTANTS
