@@ -290,6 +290,11 @@ def bench_concurrent_featurize(name="EfficientNetB0", n_images=256,
             if coalesce:
                 with telemetry.Telemetry("bench_concurrent") as tel:
                     best, spread = _best_of(run)
+                    # windowed (last-window) snapshots next to the
+                    # cumulative ones (ISSUE 7): captured inside the
+                    # scope, right after the measured repeats, so the
+                    # window holds exactly this bench's traffic
+                    wsnap = tel.metrics.window_snapshot()
                 snap = tel.metrics.snapshot()
                 tel_summary = {
                     "coalesce_requests": _hist_summary(
@@ -301,6 +306,13 @@ def bench_concurrent_featurize(name="EfficientNetB0", n_images=256,
                     "launch_s": _hist_summary(snap, telemetry.M_LAUNCH_S),
                     "occupancy": snap["gauges"].get(
                         telemetry.M_EXECUTOR_OCCUPANCY),
+                    "windowed": {
+                        "window_s": wsnap["window_s"],
+                        "queue_wait_s": _hist_summary(
+                            wsnap, telemetry.M_QUEUE_WAIT_S),
+                        "launch_s": _hist_summary(
+                            wsnap, telemetry.M_LAUNCH_S),
+                    },
                 }
             else:
                 best, spread = _best_of(run)
@@ -394,17 +406,30 @@ def bench_overload_featurize(name="EfficientNetB0", n_bulk=192,
             device_executor.reset()  # fresh queue/shed gauges per mode
             with telemetry.Telemetry("bench_overload") as tel:
                 lat = run_pair()
+                # last-window view captured in-scope, right after the
+                # flood (ISSUE 7): the windowed shed rate and queue-wait
+                # distribution, next to the cumulative ones
+                wsnap = tel.metrics.window_snapshot()
             snap = tel.metrics.snapshot()
+            shed_metric = (telemetry.HEALTH_METRIC_PREFIX
+                           + health.EXECUTOR_SHED)
+            wsheds = wsnap["counters"].get(shed_metric,
+                                           {"count": 0, "rate_per_s": 0})
             results["shed_on" if shed else "shed_off"] = {
                 "interactive_s": round(lat["interactive"], 4),
                 "bulk_s": round(lat["bulk"], 4),
-                "sheds": snap["counters"].get(
-                    telemetry.HEALTH_METRIC_PREFIX + health.EXECUTOR_SHED,
-                    0),
+                "sheds": snap["counters"].get(shed_metric, 0),
                 "shed_rate": snap["gauges"].get(
                     telemetry.M_EXECUTOR_SHED_RATE),
                 "queue_wait_s": _hist_summary(snap,
                                               telemetry.M_QUEUE_WAIT_S),
+                "windowed": {
+                    "window_s": wsnap["window_s"],
+                    "sheds": wsheds["count"],
+                    "shed_rate_per_s": wsheds["rate_per_s"],
+                    "queue_wait_s": _hist_summary(
+                        wsnap, telemetry.M_QUEUE_WAIT_S),
+                },
             }
     finally:
         EngineConfig.restore(saved)
@@ -412,6 +437,48 @@ def bench_overload_featurize(name="EfficientNetB0", n_bulk=192,
     results["interactive_ips_shed_on"] = round(
         n_interactive / results["shed_on"]["interactive_s"], 2)
     return results
+
+
+def bench_exporter_overhead(name="EfficientNetB0", n_images=128,
+                            partitions=8, size=(224, 224)):
+    """ISSUE 7 satellite: the periodic snapshot exporter's cost on a
+    real featurize loop — images/sec with the exporter ON (0.2 s
+    snapshot cadence + default SLO watchdog, files to a temp dir) vs
+    OFF, under otherwise-identical telemetry scopes. The acceptance
+    budget is < 5% overhead: the live plane must be cheap enough to
+    leave on in production."""
+    import jax.numpy as jnp
+    import pyarrow as pa
+
+    from sparkdl_tpu.core import telemetry
+    from sparkdl_tpu.engine.dataframe import DataFrame
+    from sparkdl_tpu.image import imageIO
+    from sparkdl_tpu.ml import DeepImageFeaturizer
+
+    rng = np.random.default_rng(0)
+    rows = [{"image": imageIO.imageArrayToStruct(
+        rng.integers(0, 255, size=size + (3,), dtype=np.uint8))}
+        for _ in range(n_images)]
+    schema = pa.schema([pa.field("image", imageIO.imageSchema)])
+    df = DataFrame.fromRows(rows, schema=schema,
+                            numPartitions=partitions)
+    t = DeepImageFeaturizer(inputCol="image", outputCol="features",
+                            modelName=name, batchSize=HEADLINE_BATCH,
+                            dtype=jnp.bfloat16, weights="random")
+
+    def run():
+        out = t.transform(df).select("features").collect()
+        assert len(out) == n_images
+
+    run()  # warmup: compile + host caches
+    with telemetry.Telemetry("bench_exporter_off"):
+        t_off, sp_off = _best_of(run)
+    with tempfile.TemporaryDirectory() as d:
+        with telemetry.Telemetry("bench_exporter_on", out_dir=d,
+                                 export_interval_s=0.2) as tel_on:
+            t_on, sp_on = _best_of(run)
+        snapshots = tel_on.exporter.seq
+    return (n_images / t_on, n_images / t_off, sp_on, sp_off, snapshots)
 
 
 def bench_batch_inference(name, n_images=256, size=(224, 224)):
@@ -633,6 +700,18 @@ def main():
                  "(EfficientNetB0 flood past queue bound, shed mode)",
                  ov["interactive_ips_shed_on"], "images/sec",
                  shed_on=ov["shed_on"], shed_off=ov["shed_off"])
+            # live observability plane (ISSUE 7): the periodic exporter's
+            # cost must stay under 5% — measured on the same featurize
+            # loop with the exporter on vs off
+            (xips_on, xips_off, xsp_on, xsp_off,
+             xsnaps) = bench_exporter_overhead()
+            emit("exporter-on featurize images/sec (EfficientNetB0, "
+                 "0.2s snapshot cadence)", xips_on, "images/sec",
+                 spread=round(xsp_on, 4),
+                 exporter_off=round(xips_off, 2),
+                 exporter_off_spread=round(xsp_off, 4),
+                 overhead_frac=round(1 - xips_on / max(xips_off, 1e-9), 4),
+                 snapshots=xsnaps)
 
             for name, size in (("ResNet50", (224, 224)),
                                ("Xception", (299, 299))):

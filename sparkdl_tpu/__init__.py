@@ -91,6 +91,9 @@ _LAZY_EXPORTS = {
     "Telemetry": ("sparkdl_tpu.core", "Telemetry"),
     "telemetry": ("sparkdl_tpu.core", "telemetry"),
     "HealthMonitor": ("sparkdl_tpu.core", "HealthMonitor"),
+    "slo": ("sparkdl_tpu.core", "slo"),
+    "SLORule": ("sparkdl_tpu.core", "SLORule"),
+    "SLOWatchdog": ("sparkdl_tpu.core", "SLOWatchdog"),
     # training surface
     "Trainer": ("sparkdl_tpu.train", "Trainer"),
     "TPURunner": ("sparkdl_tpu.train", "TPURunner"),

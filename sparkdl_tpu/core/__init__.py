@@ -16,7 +16,9 @@ from sparkdl_tpu.core import executor
 from sparkdl_tpu.core import health
 from sparkdl_tpu.core import pipeline
 from sparkdl_tpu.core import resilience
+from sparkdl_tpu.core import slo
 from sparkdl_tpu.core import telemetry
+from sparkdl_tpu.core.slo import SLORule, SLOWatchdog
 from sparkdl_tpu.core.pipeline import DevicePrefetcher
 from sparkdl_tpu.core.health import HealthMonitor
 from sparkdl_tpu.core.resilience import (
@@ -32,9 +34,9 @@ __all__ = [
     "replicated", "shard_batch",
     "ModelFunction", "InputModel", "TensorSpec",
     "batching", "executor", "health", "pipeline", "resilience",
-    "telemetry",
+    "slo", "telemetry",
     "Deadline", "DeviceExecutor", "DevicePrefetcher", "Fault",
     "FaultInjector",
     "HealthMonitor", "MetricsRegistry", "RetryPolicy", "RunReport",
-    "Telemetry", "Tracer", "classify",
+    "SLORule", "SLOWatchdog", "Telemetry", "Tracer", "classify",
 ]

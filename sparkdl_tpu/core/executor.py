@@ -1175,6 +1175,36 @@ class DeviceExecutor:
             if not r.future.done():
                 r.future.set_result(sliced)
 
+    # -- introspection -------------------------------------------------------
+
+    def status(self) -> Dict[str, Any]:
+        """Instantaneous queue/breaker state for the telemetry
+        exporter's periodic snapshots (docs/OBSERVABILITY.md "Live
+        metrics & SLOs"). Lock order honored: ``self._lock`` is released
+        before any state's cond is taken (canonical order is
+        cond→lock)."""
+        with self._lock:
+            states = list(self._states.values())
+            out: Dict[str, Any] = {
+                "closed": self._closed,
+                "queued_requests": self._queued_total,
+                "inflight": self._inflight_total,
+                "admitted": self._admitted,
+                "shed": self._shed,
+            }
+        models = []
+        for state in states:
+            with state.cond:
+                models.append({
+                    "model": getattr(state.model, "name", "?"),
+                    "pending_requests": len(state.pending),
+                    "pending_rows": state.pending_rows,
+                    "inflight": state.inflight,
+                    "breaker_state": state.breaker_state,
+                })
+        out["models"] = models
+        return out
+
     # -- lifecycle -----------------------------------------------------------
 
     def shutdown(self) -> None:
@@ -1221,6 +1251,12 @@ def service() -> DeviceExecutor:
 def shutdown() -> None:
     """Shut the process-wide service down (fails queued requests)."""
     _service.shutdown()
+
+
+def status() -> Dict[str, Any]:
+    """Queue/breaker state of the process-wide service (the telemetry
+    exporter embeds this in every periodic snapshot)."""
+    return _service.status()
 
 
 def reset() -> DeviceExecutor:

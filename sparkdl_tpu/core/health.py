@@ -54,6 +54,11 @@ EXECUTOR_DEADLINE_SHED = "executor_deadline_shed"  # executor: request
 BREAKER_OPEN = "breaker_open"            # executor: circuit breaker tripped
 BREAKER_PROBE = "breaker_probe"          # executor: half-open probe admitted
 BREAKER_CLOSED = "breaker_closed"        # executor: probe succeeded, recovered
+SLO_BREACH = "slo_breach"                # slo: rule held in breach past its
+                                         # hold-down (paired with recovery)
+SLO_RECOVERED = "slo_recovered"          # slo: breached rule back in budget
+TELEMETRY_EXPORT_ERROR = "telemetry_export_error"  # telemetry: exporter
+                                         # tick crashed (skipped, not fatal)
 
 
 class HealthMonitor:

@@ -1,0 +1,300 @@
+"""Declarative SLO watchdog over the sliding-window metric plane.
+
+PR 6 made overload degradation *correct* (admission control, deadline
+sheds, the per-model circuit breaker); this module makes it *stated*:
+an operator declares objectives over recent time windows — "queue-wait
+p99 under a second over the last 30 s", "shed rate near zero", "no
+breaker trips" — and the telemetry scope's periodic exporter
+(:class:`~sparkdl_tpu.core.telemetry.SnapshotExporter`) evaluates them
+on every tick, emitting paired ``slo_breach`` / ``slo_recovered``
+health events with structured-log alerts while the process is alive.
+This is the substrate ROADMAP item 1's SLO-aware admission reads from:
+a rule's breach state is exactly the control signal an adaptive
+coalesce window or shed threshold needs.
+
+Design points:
+
+- **Rules are declarative and validated at construction.** An
+  :class:`SLORule` names a *declared* metric (the
+  ``core.telemetry.CANONICAL_METRIC_NAMES`` catalog, or a
+  ``sparkdl.health.<event>`` mirror of a constant declared in
+  ``core/health.py``) — a typo'd metric name raises ``ValueError``
+  instead of silently never firing, and the AST lint in
+  ``tests/test_taxonomy_lint.py`` enforces the same for every rule
+  shipped in this module.
+- **Windowed, not cumulative.** Observations come from
+  ``MetricsRegistry.window_snapshot(rule.window_s)``: a 10-minute-old
+  latency spike ages out of the verdict instead of polluting "current"
+  p99 forever.
+- **Hold-down, then exactly one pair per episode.** A rule must stay in
+  breach for ``for_s`` continuous seconds (as seen by evaluation ticks)
+  before ``slo_breach`` fires; the matching ``slo_recovered`` fires on
+  the first in-budget evaluation afterwards. No flapping storms: one
+  breach, one recovery, per violation episode.
+- **Absence of data is not a breach.** A window with no samples
+  observes ``None`` for histogram stats (and 0 for counter rates): a
+  quiet executor never pages anyone about its p99.
+
+Dependency-free (stdlib only); imports ``core.telemetry`` for the
+metric catalog and ``core.health`` for the event choke point — the
+telemetry scope imports THIS module lazily, so there is no cycle.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import operator
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+from sparkdl_tpu.core import health, telemetry
+
+logger = logging.getLogger(__name__)
+
+_COMPARATORS = {
+    ">": operator.gt,
+    ">=": operator.ge,
+    "<": operator.lt,
+    "<=": operator.le,
+}
+
+#: Stats a rule may read, per instrument kind (see :meth:`SLORule.observe`).
+_HISTOGRAM_STATS = ("p50", "p95", "p99", "count", "rate_per_s", "min",
+                    "max")
+_COUNTER_STATS = ("count", "rate_per_s")
+_GAUGE_STATS = ("value",)
+_STATS = tuple(dict.fromkeys(_HISTOGRAM_STATS + _COUNTER_STATS
+                             + _GAUGE_STATS))
+
+
+def _declared_health_metrics() -> frozenset:
+    """Every valid ``sparkdl.health.<event>`` mirror name, derived from
+    the UPPERCASE string constants declared in ``core/health.py`` — the
+    same set the taxonomy lint trusts."""
+    return frozenset(
+        telemetry.HEALTH_METRIC_PREFIX + value
+        for name, value in vars(health).items()
+        if name.isupper() and isinstance(value, str))
+
+
+@dataclasses.dataclass(frozen=True)
+class SLORule:
+    """One objective: ``<stat>(metric over window_s) <comparator>
+    threshold`` must NOT hold (holding = breaching) for ``for_s``
+    continuous seconds.
+
+    ``metric`` must be a declared name — a ``CANONICAL_METRIC_NAMES``
+    entry or a ``sparkdl.health.<declared event>`` mirror; anything else
+    raises at construction (a typo'd rule must fail loudly, not watch
+    nothing forever).
+    """
+
+    name: str
+    metric: str
+    window_s: float
+    threshold: float
+    comparator: str = ">"
+    stat: str = "p99"
+    for_s: float = 0.0
+
+    def __post_init__(self) -> None:
+        if not self.name:
+            raise ValueError("SLORule.name must be non-empty")
+        if self.comparator not in _COMPARATORS:
+            raise ValueError(
+                f"SLORule {self.name!r}: comparator must be one of "
+                f"{tuple(_COMPARATORS)}, got {self.comparator!r}")
+        if self.stat not in _STATS:
+            raise ValueError(
+                f"SLORule {self.name!r}: stat must be one of {_STATS}, "
+                f"got {self.stat!r}")
+        if self.window_s <= 0:
+            raise ValueError(
+                f"SLORule {self.name!r}: window_s must be > 0, got "
+                f"{self.window_s!r}")
+        if self.for_s < 0:
+            raise ValueError(
+                f"SLORule {self.name!r}: for_s must be >= 0, got "
+                f"{self.for_s!r}")
+        kind = telemetry.CANONICAL_METRIC_KINDS.get(self.metric)
+        if kind is None:
+            if self.metric in _declared_health_metrics():
+                kind = "counter"  # health mirrors are always counters
+            else:
+                raise ValueError(
+                    f"SLORule {self.name!r}: metric {self.metric!r} is "
+                    "not a declared name — use a core.telemetry."
+                    "CANONICAL_METRIC_NAMES entry or a sparkdl.health."
+                    "<event> mirror of a constant declared in "
+                    "core/health.py")
+        allowed = {"histogram": _HISTOGRAM_STATS,
+                   "counter": _COUNTER_STATS,
+                   "gauge": _GAUGE_STATS}[kind]
+        if self.stat not in allowed:
+            # a stat the instrument kind can never produce would observe
+            # None forever — watching nothing, silently
+            raise ValueError(
+                f"SLORule {self.name!r}: stat {self.stat!r} cannot be "
+                f"observed on {self.metric!r} (a {kind}); valid stats: "
+                f"{allowed}")
+
+    def observe(self, windowed: Dict[str, Any]) -> Optional[float]:
+        """Extract this rule's stat from one
+        ``MetricsRegistry.window_snapshot`` result; ``None`` when the
+        window holds no data for the metric."""
+        hist = windowed["histograms"].get(self.metric)
+        if hist is not None and self.stat in _HISTOGRAM_STATS:
+            return hist.get(self.stat)
+        ctr = windowed["counters"].get(self.metric)
+        if ctr is not None and self.stat in _COUNTER_STATS:
+            return ctr.get(self.stat)
+        gauge = windowed["gauges"].get(self.metric)
+        if gauge is not None and self.stat == "value":
+            return gauge.get("last")
+        return None
+
+    def breaching(self, observed: Optional[float]) -> bool:
+        if observed is None:
+            return False  # no data is never a breach
+        return _COMPARATORS[self.comparator](observed, self.threshold)
+
+
+class _RuleState:
+    __slots__ = ("breach_since", "active", "last_observed")
+
+    def __init__(self) -> None:
+        self.breach_since: Optional[float] = None
+        self.active = False
+        self.last_observed: Optional[float] = None
+
+
+class SLOWatchdog:
+    """Evaluates a rule set against a registry's windowed snapshots.
+
+    One instance per telemetry scope (built by ``Telemetry.__enter__``
+    when the exporter is on); :meth:`evaluate` is called on every
+    exporter tick and at the final flush. Not thread-safe by design —
+    only the exporter (one thread, plus the close-time flush under the
+    exporter's tick lock) drives it.
+    """
+
+    def __init__(self, rules: Optional[Sequence[SLORule]] = None) -> None:
+        self.rules: Tuple[SLORule, ...] = tuple(
+            DEFAULT_RULES if rules is None else rules)
+        names = [r.name for r in self.rules]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate SLO rule names: {names}")
+        self._states = {r.name: _RuleState() for r in self.rules}
+        self._capacity_warned: set = set()
+
+    def evaluate(self, registry: "telemetry.MetricsRegistry",
+                 now: Optional[float] = None) -> Dict[str, Any]:
+        """One evaluation pass: returns ``{rule: {observed, threshold,
+        breached}}`` (the exporter embeds it in each snapshot line) and
+        emits the breach/recovery events."""
+        if now is None:
+            now = telemetry._monotonic()
+        snaps: Dict[float, Dict[str, Any]] = {}
+        out: Dict[str, Any] = {}
+        for rule in self.rules:
+            windowed = snaps.get(rule.window_s)
+            if windowed is None:
+                windowed = snaps[rule.window_s] = \
+                    registry.window_snapshot(rule.window_s)
+            if (windowed["window_s"] is not None
+                    and windowed["window_s"] + 1e-9 < rule.window_s
+                    and rule.name not in self._capacity_warned):
+                # the registry's ring can't answer the declared window;
+                # Telemetry rejects this pairing at construction, but a
+                # standalone watchdog must still say so (once), not
+                # silently judge over less history than the rule states
+                self._capacity_warned.add(rule.name)
+                logger.warning(
+                    "SLO rule %r window_s=%g exceeds the registry ring "
+                    "capacity (%gs); evaluating over the capped window",
+                    rule.name, rule.window_s, windowed["window_s"])
+            state = self._states[rule.name]
+            observed = rule.observe(windowed)
+            state.last_observed = observed
+            if rule.breaching(observed):
+                if state.breach_since is None:
+                    state.breach_since = now
+                if (not state.active
+                        and now - state.breach_since >= rule.for_s):
+                    state.active = True
+                    health.record(health.SLO_BREACH, rule=rule.name,
+                                  metric=rule.metric, stat=rule.stat,
+                                  observed=observed,
+                                  threshold=rule.threshold,
+                                  window_s=rule.window_s)
+                    logger.warning(
+                        "SLO breach %r: %s(%s over %gs) = %.6g %s %.6g "
+                        "(held %.3gs)", rule.name, rule.stat, rule.metric,
+                        rule.window_s, observed, rule.comparator,
+                        rule.threshold, now - state.breach_since)
+            else:
+                state.breach_since = None
+                if state.active:
+                    state.active = False
+                    health.record(health.SLO_RECOVERED, rule=rule.name,
+                                  metric=rule.metric, stat=rule.stat,
+                                  observed=observed,
+                                  threshold=rule.threshold,
+                                  window_s=rule.window_s)
+                    logger.warning(
+                        "SLO recovered %r: %s(%s over %gs) = %s, back "
+                        "within %s %.6g", rule.name, rule.stat,
+                        rule.metric, rule.window_s,
+                        ("%.6g" % observed) if observed is not None
+                        else "no data", rule.comparator, rule.threshold)
+            out[rule.name] = {"observed": observed,
+                              "threshold": rule.threshold,
+                              "breached": state.active}
+        return out
+
+    def state(self) -> Dict[str, Dict[str, Any]]:
+        """Current per-rule verdicts (for tests and ad-hoc queries)."""
+        return {r.name: {"breached": self._states[r.name].active,
+                         "observed": self._states[r.name].last_observed}
+                for r in self.rules}
+
+
+# ---------------------------------------------------------------------------
+# Default rules: make PR 6's degradation story observable out of the box
+# ---------------------------------------------------------------------------
+
+DEFAULT_WINDOW_S = 30.0
+DEFAULT_QUEUE_WAIT_P99_S = 1.0   # executor queue wait must stay sub-second
+DEFAULT_SHED_RATE_PER_S = 1.0    # sustained shedding, not a lone blip
+DEFAULT_HOLD_S = 0.0
+
+
+def default_rules(window_s: float = DEFAULT_WINDOW_S,
+                  for_s: float = DEFAULT_HOLD_S,
+                  queue_wait_p99_s: float = DEFAULT_QUEUE_WAIT_P99_S,
+                  shed_rate_per_s: float = DEFAULT_SHED_RATE_PER_S,
+                  ) -> Tuple[SLORule, ...]:
+    """The shipped rule set, re-parameterized (tests and short-lived
+    scopes want second-scale windows; the defaults suit serving)."""
+    return (
+        # the latency objective: queue-wait p99 over the window
+        SLORule("executor_queue_wait_p99",
+                metric=telemetry.M_QUEUE_WAIT_S,
+                window_s=window_s, threshold=queue_wait_p99_s,
+                comparator=">", stat="p99", for_s=for_s),
+        # the loss objective: sustained admission shedding
+        SLORule("executor_shed_rate",
+                metric=telemetry.HEALTH_METRIC_PREFIX
+                + health.EXECUTOR_SHED,
+                window_s=window_s, threshold=shed_rate_per_s,
+                comparator=">=", stat="rate_per_s", for_s=for_s),
+        # the availability objective: any breaker trip in the window
+        SLORule("executor_breaker_open",
+                metric=telemetry.HEALTH_METRIC_PREFIX
+                + health.BREAKER_OPEN,
+                window_s=window_s, threshold=1.0,
+                comparator=">=", stat="count", for_s=for_s),
+    )
+
+
+DEFAULT_RULES: Tuple[SLORule, ...] = default_rules()

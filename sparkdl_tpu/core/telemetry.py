@@ -32,6 +32,18 @@ that, in three integrated parts:
    at scope exit (opt-in via ``SPARKDL_TELEMETRY_DIR`` or an explicit
    ``Telemetry(out_dir=...)`` scope), plus a structured-logging adapter
    stamping ``run_id``/``trace_id`` onto framework log records.
+4. **Live plane** (docs/OBSERVABILITY.md "Live metrics & SLOs") — every
+   instrument a scope creates additionally feeds a fixed-size ring of
+   time-bucketed sub-snapshots (monotonic-clock rotation, O(1) record
+   path), so :meth:`MetricsRegistry.window_snapshot` answers "rate and
+   p50/p95/p99 over the last N seconds" alongside the cumulative views
+   — a 10-minute-old latency spike no longer pollutes "current" p99.
+   A :class:`SnapshotExporter` daemon thread inside the scope writes a
+   JSON-lines snapshot (windowed + cumulative + executor queue/breaker
+   state) and an atomically-replaced Prometheus text file every
+   ``export_interval_s``, evaluates the ``core.slo`` watchdog rules on
+   each tick, and flushes one final snapshot at scope exit; the run
+   report gains a ``timeline`` summary derived from the snapshots.
 
 Scoping mirrors :class:`~sparkdl_tpu.core.health.HealthMonitor`:
 a :class:`Telemetry` scope activates process-wide (engine partition ops
@@ -62,6 +74,14 @@ from collections import deque
 from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 TELEMETRY_DIR_ENV = "SPARKDL_TELEMETRY_DIR"
+# Opt-in periodic exporter cadence (seconds) for scopes that don't pass
+# export_interval_s explicitly; requires TELEMETRY_DIR for file output.
+EXPORT_INTERVAL_ENV = "SPARKDL_TELEMETRY_EXPORT_S"
+
+# The window rings and the exporter read THIS clock (monotonic by
+# default) so tests can drive rotation/cadence deterministically with a
+# fake clock. The span hot path keeps calling perf_counter_ns directly.
+_monotonic = time.monotonic
 
 # ---------------------------------------------------------------------------
 # Canonical names (docs/OBSERVABILITY.md is the human-readable catalog).
@@ -124,14 +144,33 @@ M_EXECUTOR_QUEUE_DEPTH = "sparkdl.executor.queue_depth"  # gauge (queued reqs)
 M_EXECUTOR_SHED_RATE = "sparkdl.executor.shed_rate"    # gauge (shed fraction)
 HEALTH_METRIC_PREFIX = "sparkdl.health."
 
-CANONICAL_METRIC_NAMES = frozenset({
-    M_TASK_DURATION_S, M_STEP_TIME_S, M_STEPS_PER_SEC, M_EXAMPLES_PER_SEC,
-    M_PREFETCH_DEPTH, M_PREFETCH_STALL_S, M_BATCH_ROWS, M_BATCH_PAD_ROWS,
-    M_BATCH_BUCKET_ROWS, M_PADDING_WASTE, M_ENGINE_ROWS_OUT,
-    M_ENGINE_BYTES_OUT, M_COALESCE_REQUESTS, M_COALESCE_ROWS,
-    M_COALESCE_DEDUP, M_QUEUE_WAIT_S, M_LAUNCH_S, M_EXECUTOR_OCCUPANCY,
-    M_EXECUTOR_QUEUE_DEPTH, M_EXECUTOR_SHED_RATE,
-})
+# Instrument kind per canonical metric — machine-readable so core/slo.py
+# can reject a rule whose stat can never be observed on its metric (a
+# p99 of a counter would silently watch nothing).
+CANONICAL_METRIC_KINDS: Dict[str, str] = {
+    M_TASK_DURATION_S: "histogram",
+    M_STEP_TIME_S: "histogram",
+    M_STEPS_PER_SEC: "histogram",
+    M_EXAMPLES_PER_SEC: "gauge",
+    M_PREFETCH_DEPTH: "gauge",
+    M_PREFETCH_STALL_S: "histogram",
+    M_BATCH_ROWS: "counter",
+    M_BATCH_PAD_ROWS: "counter",
+    M_BATCH_BUCKET_ROWS: "histogram",
+    M_PADDING_WASTE: "gauge",
+    M_ENGINE_ROWS_OUT: "counter",
+    M_ENGINE_BYTES_OUT: "counter",
+    M_COALESCE_REQUESTS: "histogram",
+    M_COALESCE_ROWS: "histogram",
+    M_COALESCE_DEDUP: "counter",
+    M_QUEUE_WAIT_S: "histogram",
+    M_LAUNCH_S: "histogram",
+    M_EXECUTOR_OCCUPANCY: "gauge",
+    M_EXECUTOR_QUEUE_DEPTH: "gauge",
+    M_EXECUTOR_SHED_RATE: "gauge",
+}
+
+CANONICAL_METRIC_NAMES = frozenset(CANONICAL_METRIC_KINDS)
 
 # ---------------------------------------------------------------------------
 # Span tracing
@@ -360,19 +399,83 @@ DEFAULT_TIME_BOUNDS: Tuple[float, ...] = tuple(
 POW2_BOUNDS: Tuple[float, ...] = tuple(float(2 ** i) for i in range(17))
 
 
+def _estimate_percentile(q: float, counts: Sequence[int], count: int,
+                         bounds: Sequence[float], vmin: Optional[float],
+                         vmax: Optional[float]) -> Optional[float]:
+    """Estimated q-quantile from ONE consistent copy of log-scale bucket
+    counts: the geometric midpoint of the covering bucket, clamped to the
+    observed [vmin, vmax]. Returns ``None`` (JSON null) for an empty
+    histogram or window — never a bucket-midpoint guess over zero
+    samples."""
+    if count <= 0:
+        return None
+    target = max(1, math.ceil(q * count))
+    cum = 0
+    for i, c in enumerate(counts):
+        cum += c
+        if cum >= target:
+            lo = bounds[i - 1] if i > 0 else 0.0
+            hi = (bounds[i] if i < len(bounds)
+                  else (vmax if vmax is not None else lo))
+            est = math.sqrt(lo * hi) if lo > 0 and hi > 0 else hi
+            if vmin is not None:
+                est = max(est, vmin)
+            if vmax is not None:
+                est = min(est, vmax)
+            return est
+    return vmax
+
+
+def _window_floor(span_s: float, slots: int, window_s: float) -> int:
+    """Oldest slot epoch inside a trailing ``window_s`` window (clamped
+    to the ring capacity). The current partial slot is always included,
+    so the effective window is ``window_s`` ± one slot span."""
+    k = min(slots, max(1, math.ceil(window_s / span_s)))
+    return int(_monotonic() / span_s) - k + 1
+
+
 class Counter:
-    """Monotonic counter."""
+    """Monotonic counter. With ``window=(span_s, slots)`` it also keeps a
+    fixed ring of time-bucketed sub-counts (lazy monotonic-clock
+    rotation, O(1) per inc) so :meth:`window_count` can answer "how many
+    in the last N seconds" without a timer thread."""
 
-    __slots__ = ("name", "_lock", "_value")
+    __slots__ = ("name", "_lock", "_value", "_w_span", "_w_epochs",
+                 "_w_counts")
 
-    def __init__(self, name: str) -> None:
+    def __init__(self, name: str,
+                 window: Optional[Tuple[float, int]] = None) -> None:
         self.name = name
         self._lock = threading.Lock()
         self._value = 0
+        self._w_span: Optional[float] = None
+        if window is not None:
+            span_s, slots = window
+            self._w_span = float(span_s)
+            self._w_epochs = [-1] * slots
+            self._w_counts = [0] * slots
 
     def inc(self, n: int = 1) -> None:
         with self._lock:
             self._value += n
+            if self._w_span is not None:
+                epoch = int(_monotonic() / self._w_span)
+                i = epoch % len(self._w_counts)
+                if self._w_epochs[i] != epoch:  # lazy rotation
+                    self._w_epochs[i] = epoch
+                    self._w_counts[i] = 0
+                self._w_counts[i] += n
+
+    def window_count(self, window_s: float) -> int:
+        """Occurrences within the trailing ``window_s`` (0 without a
+        ring; resolution = one ring slot)."""
+        if self._w_span is None:
+            return 0
+        with self._lock:
+            floor_epoch = _window_floor(self._w_span, len(self._w_counts),
+                                        window_s)
+            return sum(c for e, c in zip(self._w_epochs, self._w_counts)
+                       if e >= floor_epoch)
 
     @property
     def value(self) -> int:
@@ -381,18 +484,58 @@ class Counter:
 
 
 class Gauge:
-    """Last-write-wins instantaneous value."""
+    """Last-write-wins instantaneous value. With ``window=`` it also
+    remembers (last, min, max) per ring slot so the windowed view can
+    report the envelope of the last N seconds, not just the final
+    write."""
 
-    __slots__ = ("name", "_lock", "_value")
+    __slots__ = ("name", "_lock", "_value", "_w_span", "_w_epochs",
+                 "_w_vals")
 
-    def __init__(self, name: str) -> None:
+    def __init__(self, name: str,
+                 window: Optional[Tuple[float, int]] = None) -> None:
         self.name = name
         self._lock = threading.Lock()
         self._value: Optional[float] = None
+        self._w_span: Optional[float] = None
+        if window is not None:
+            span_s, slots = window
+            self._w_span = float(span_s)
+            self._w_epochs = [-1] * slots
+            self._w_vals: List[Optional[Tuple[float, float, float]]] = \
+                [None] * slots
 
     def set(self, value: float) -> None:
+        value = float(value)
         with self._lock:
-            self._value = float(value)
+            self._value = value
+            if self._w_span is not None:
+                epoch = int(_monotonic() / self._w_span)
+                i = epoch % len(self._w_vals)
+                if self._w_epochs[i] != epoch:
+                    self._w_epochs[i] = epoch
+                    self._w_vals[i] = (value, value, value)
+                else:
+                    last, lo, hi = self._w_vals[i]  # type: ignore[misc]
+                    self._w_vals[i] = (value, min(lo, value),
+                                       max(hi, value))
+
+    def window_values(self, window_s: float) -> Optional[Dict[str, float]]:
+        """``{'last', 'min', 'max'}`` over the trailing window; ``None``
+        when the window saw no :meth:`set` (or there is no ring)."""
+        if self._w_span is None:
+            return None
+        with self._lock:
+            floor_epoch = _window_floor(self._w_span, len(self._w_vals),
+                                        window_s)
+            seen = sorted((e, v) for e, v in zip(self._w_epochs,
+                                                 self._w_vals)
+                          if e >= floor_epoch and v is not None)
+        if not seen:
+            return None
+        return {"last": seen[-1][1][0],
+                "min": min(v[1] for _, v in seen),
+                "max": max(v[2] for _, v in seen)}
 
     @property
     def value(self) -> Optional[float]:
@@ -411,10 +554,11 @@ class Histogram:
     """
 
     __slots__ = ("name", "_lock", "bounds", "_counts", "count", "sum",
-                 "min", "max")
+                 "min", "max", "_w_span", "_w_epochs", "_w_slots")
 
     def __init__(self, name: str,
-                 bounds: Sequence[float] = DEFAULT_TIME_BOUNDS) -> None:
+                 bounds: Sequence[float] = DEFAULT_TIME_BOUNDS,
+                 window: Optional[Tuple[float, int]] = None) -> None:
         self.name = name
         self._lock = threading.Lock()
         self.bounds = tuple(float(b) for b in bounds)
@@ -423,6 +567,16 @@ class Histogram:
         self.sum = 0.0
         self.min: Optional[float] = None
         self.max: Optional[float] = None
+        self._w_span: Optional[float] = None
+        if window is not None:
+            span_s, slots = window
+            self._w_span = float(span_s)
+            self._w_epochs = [-1] * slots
+            # one sub-histogram per ring slot: [counts, count, sum, min,
+            # max]; reset lazily when its slot's epoch rotates past
+            self._w_slots: List[List[Any]] = [
+                [[0] * (len(self.bounds) + 1), 0, 0.0, None, None]
+                for _ in range(slots)]
 
     def observe(self, value: float) -> None:
         value = float(value)
@@ -435,26 +589,35 @@ class Histogram:
                 self.min = value
             if self.max is None or value > self.max:
                 self.max = value
+            if self._w_span is not None:
+                epoch = int(_monotonic() / self._w_span)
+                i = epoch % len(self._w_slots)
+                slot = self._w_slots[i]
+                if self._w_epochs[i] != epoch:  # lazy rotation
+                    self._w_epochs[i] = epoch
+                    slot[0] = [0] * (len(self.bounds) + 1)
+                    slot[1], slot[2] = 0, 0.0
+                    slot[3] = slot[4] = None
+                slot[0][idx] += 1
+                slot[1] += 1
+                slot[2] += value
+                if slot[3] is None or value < slot[3]:
+                    slot[3] = value
+                if slot[4] is None or value > slot[4]:
+                    slot[4] = value
 
     def percentile(self, q: float) -> Optional[float]:
-        """Estimated q-quantile (q in [0, 1]) from the bucket counts."""
+        """Estimated q-quantile (q in [0, 1]) from the bucket counts
+        (``None`` on an empty histogram)."""
         with self._lock:
-            if self.count == 0:
-                return None
-            target = max(1, math.ceil(q * self.count))
-            cum = 0
-            for i, c in enumerate(self._counts):
-                cum += c
-                if cum >= target:
-                    lo = self.bounds[i - 1] if i > 0 else 0.0
-                    hi = (self.bounds[i] if i < len(self.bounds)
-                          else (self.max if self.max is not None else lo))
-                    if lo > 0 and hi > 0:
-                        est = math.sqrt(lo * hi)
-                    else:
-                        est = hi
-                    return min(max(est, self.min), self.max)
-            return self.max
+            return _estimate_percentile(q, self._counts, self.count,
+                                        self.bounds, self.min, self.max)
+
+    def _raw(self) -> Tuple[Tuple[float, ...], List[int], int, float]:
+        """(bounds, counts, count, sum) as one consistent locked copy —
+        the Prometheus exposition source."""
+        with self._lock:
+            return self.bounds, list(self._counts), self.count, self.sum
 
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
@@ -464,34 +627,108 @@ class Histogram:
         buckets = {("+Inf" if i == len(self.bounds)
                     else repr(self.bounds[i])): c
                    for i, c in enumerate(counts) if c}
+        # percentiles from the SAME locked copy as the buckets (a
+        # concurrent observe between the copy and the estimate cannot
+        # skew them apart), None — not a midpoint guess — when empty
         return {
             "count": count, "sum": round(total, 9), "min": lo, "max": hi,
-            "p50": self.percentile(0.50), "p95": self.percentile(0.95),
-            "p99": self.percentile(0.99), "buckets": buckets,
+            "p50": _estimate_percentile(0.50, counts, count, self.bounds,
+                                        lo, hi),
+            "p95": _estimate_percentile(0.95, counts, count, self.bounds,
+                                        lo, hi),
+            "p99": _estimate_percentile(0.99, counts, count, self.bounds,
+                                        lo, hi),
+            "buckets": buckets,
+        }
+
+    def window_snapshot(self, window_s: float) -> Dict[str, Any]:
+        """Merged ``{count, sum, rate_per_s, min, max, p50, p95, p99}``
+        over the trailing ``window_s`` (resolution = one ring slot).
+        Percentiles and min/max are ``None`` on an empty window; all
+        zeros/None without a ring."""
+        counts = [0] * (len(self.bounds) + 1)
+        count, total = 0, 0.0
+        vmin: Optional[float] = None
+        vmax: Optional[float] = None
+        if self._w_span is not None:
+            with self._lock:
+                floor_epoch = _window_floor(self._w_span,
+                                            len(self._w_slots), window_s)
+                for e, slot in zip(self._w_epochs, self._w_slots):
+                    if e < floor_epoch or not slot[1]:
+                        continue
+                    for j, c in enumerate(slot[0]):
+                        counts[j] += c
+                    count += slot[1]
+                    total += slot[2]
+                    vmin = slot[3] if vmin is None else min(vmin, slot[3])
+                    vmax = slot[4] if vmax is None else max(vmax, slot[4])
+        return {
+            "count": count, "sum": round(total, 9),
+            "rate_per_s": round(count / window_s, 9) if window_s else 0.0,
+            "min": vmin, "max": vmax,
+            "p50": _estimate_percentile(0.50, counts, count, self.bounds,
+                                        vmin, vmax),
+            "p95": _estimate_percentile(0.95, counts, count, self.bounds,
+                                        vmin, vmax),
+            "p99": _estimate_percentile(0.99, counts, count, self.bounds,
+                                        vmin, vmax),
         }
 
 
-class MetricsRegistry:
-    """Get-or-create registry of named instruments (one per name)."""
+def escape_label_value(value: Any) -> str:
+    """Prometheus text-exposition label-value escaping: backslash,
+    double-quote and newline (in that order, per the 0.0.4 format)."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
 
-    def __init__(self) -> None:
+
+def _escape_help(text: str) -> str:
+    """HELP-line escaping: backslash and newline only (quotes are legal
+    in HELP text)."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+class MetricsRegistry:
+    """Get-or-create registry of named instruments (one per name).
+
+    ``window_s``/``window_buckets`` arm the sliding-window rings on every
+    instrument the registry creates: ``window_s`` is the largest
+    queryable trailing window, bucketed into ``window_buckets`` ring
+    slots (the window resolution). ``window_s=None`` (the bare-registry
+    default) creates ring-free instruments — the pre-windowing record
+    path, not even a clock read per record."""
+
+    def __init__(self, window_s: Optional[float] = None,
+                 window_buckets: int = 12) -> None:
         self._lock = threading.Lock()
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
         self._histograms: Dict[str, Histogram] = {}
+        self._window: Optional[Tuple[float, int]] = None
+        if window_s is not None:
+            if window_s <= 0 or window_buckets <= 0:
+                raise ValueError(
+                    "window_s and window_buckets must be > 0, got "
+                    f"{window_s!r}/{window_buckets!r}")
+            self._window = (float(window_s) / int(window_buckets),
+                            int(window_buckets))
+        self.window_s = window_s
 
     def counter(self, name: str) -> Counter:
         with self._lock:
             inst = self._counters.get(name)
             if inst is None:
-                inst = self._counters[name] = Counter(name)
+                inst = self._counters[name] = Counter(
+                    name, window=self._window)
             return inst
 
     def gauge(self, name: str) -> Gauge:
         with self._lock:
             inst = self._gauges.get(name)
             if inst is None:
-                inst = self._gauges[name] = Gauge(name)
+                inst = self._gauges[name] = Gauge(name,
+                                                  window=self._window)
             return inst
 
     def histogram(self, name: str,
@@ -500,7 +737,8 @@ class MetricsRegistry:
         with self._lock:
             inst = self._histograms.get(name)
             if inst is None:
-                inst = self._histograms[name] = Histogram(name, bounds)
+                inst = self._histograms[name] = Histogram(
+                    name, bounds, window=self._window)
             return inst
 
     def snapshot(self) -> Dict[str, Any]:
@@ -516,40 +754,275 @@ class MetricsRegistry:
                            for k in sorted(histograms)},
         }
 
+    def window_snapshot(self, window_s: Optional[float] = None
+                        ) -> Dict[str, Any]:
+        """Sliding-window view over every instrument: counter counts and
+        rates, gauge last/min/max envelopes, histogram percentiles —
+        all over the trailing ``window_s`` seconds (default and cap: the
+        ring capacity). Resolution is one ring slot, and the current
+        partial slot is included, so the effective window is
+        ``window_s`` ± one slot. Empty sections when the registry was
+        built without windows."""
+        if self._window is None:
+            return {"window_s": None, "counters": {}, "gauges": {},
+                    "histograms": {}}
+        span, slots = self._window
+        if window_s is None:
+            window_s = span * slots
+        window_s = min(float(window_s), span * slots)
+        if window_s <= 0:
+            raise ValueError(f"window_s must be > 0, got {window_s!r}")
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            histograms = dict(self._histograms)
+        out_counters = {}
+        for k in sorted(counters):
+            c = counters[k].window_count(window_s)
+            out_counters[k] = {"count": c,
+                               "rate_per_s": round(c / window_s, 9)}
+        out_gauges = {}
+        for k in sorted(gauges):
+            v = gauges[k].window_values(window_s)
+            if v is not None:
+                out_gauges[k] = v
+        return {
+            "window_s": window_s,
+            "counters": out_counters,
+            "gauges": out_gauges,
+            "histograms": {k: histograms[k].window_snapshot(window_s)
+                           for k in sorted(histograms)},
+        }
+
     def prometheus_text(self) -> str:
-        """Prometheus text exposition (0.0.4) dump of every instrument."""
+        """Prometheus text exposition (0.0.4) dump of every instrument:
+        one ``# HELP`` + ``# TYPE`` pair per metric family, escaped
+        label values, cumulative histogram buckets with a closing
+        ``+Inf``."""
         import re as _re
 
         def sane(name: str) -> str:
             return _re.sub(r"[^a-zA-Z0-9_:]", "_", name)
 
         lines: List[str] = []
+
+        def family(name: str, kind: str) -> str:
+            n = sane(name)
+            lines.append(
+                f"# HELP {n} {_escape_help(name)} (sparkdl_tpu {kind})")
+            lines.append(f"# TYPE {n} {kind}")
+            return n
+
         snap = self.snapshot()
         for name, value in snap["counters"].items():
-            n = sane(name)
-            lines += [f"# TYPE {n} counter", f"{n} {value}"]
+            n = family(name, "counter")
+            lines.append(f"{n} {value}")
         for name, value in snap["gauges"].items():
             if value is None:
                 continue
-            n = sane(name)
-            lines += [f"# TYPE {n} gauge", f"{n} {value}"]
+            n = family(name, "gauge")
+            lines.append(f"{n} {value}")
         with self._lock:
             hists = dict(self._histograms)
         for name in sorted(hists):
-            h = hists[name]
-            n = sane(name)
-            lines.append(f"# TYPE {n} histogram")
-            with h._lock:
-                counts = list(h._counts)
-                count, total = h.count, h.sum
+            bounds, counts, count, total = hists[name]._raw()
+            n = family(name, "histogram")
             cum = 0
-            for i, bound in enumerate(h.bounds):
+            for i, bound in enumerate(bounds):
                 cum += counts[i]
-                lines.append(f'{n}_bucket{{le="{bound}"}} {cum}')
+                le = escape_label_value(repr(bound))
+                lines.append(f'{n}_bucket{{le="{le}"}} {cum}')
             lines.append(f'{n}_bucket{{le="+Inf"}} {count}')
             lines.append(f"{n}_sum {total}")
             lines.append(f"{n}_count {count}")
         return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# Periodic snapshot exporter (the live half of the run report)
+# ---------------------------------------------------------------------------
+
+
+class SnapshotExporter:
+    """Periodic live-snapshot exporter for one telemetry scope.
+
+    Every ``interval_s`` (daemon thread; drop-safe final flush at
+    :meth:`close`) a tick:
+
+    - appends one JSON line — sequence number, uptime, windowed +
+      cumulative metric snapshots, executor queue/breaker state — to
+      ``sparkdl_snapshots_<run_id>.jsonl`` under ``out_dir``;
+    - atomically replaces ``sparkdl_metrics_<run_id>.prom`` (temp file +
+      ``os.replace``) so a Prometheus textfile collector never reads a
+      torn exposition;
+    - evaluates the scope's SLO watchdog (``core/slo.py``) so breaches
+      surface while the process is alive, not in the post-mortem.
+
+    Without an ``out_dir`` no files are written but ticks still run
+    (watchdog + the bounded in-memory timeline that feeds the run
+    report). A tick that crashes records one ``telemetry_export_error``
+    health event and keeps going — the exporter never takes the run
+    down and never dies silently.
+    """
+
+    def __init__(self, tel: "Telemetry", interval_s: float,
+                 out_dir: Optional[str] = None, watchdog: Any = None,
+                 timeline_max: int = 240) -> None:
+        if interval_s <= 0:
+            raise ValueError(
+                f"export_interval_s must be > 0, got {interval_s!r}")
+        self.tel = tel
+        self.interval_s = float(interval_s)
+        self.out_dir = out_dir
+        self.watchdog = watchdog
+        self.seq = 0
+        self.errors = 0
+        self.snapshot_path: Optional[str] = None
+        self.prom_path: Optional[str] = None
+        if out_dir:
+            os.makedirs(out_dir, exist_ok=True)
+            self.snapshot_path = os.path.join(
+                out_dir, f"sparkdl_snapshots_{tel.run_id}.jsonl")
+            self.prom_path = os.path.join(
+                out_dir, f"sparkdl_metrics_{tel.run_id}.prom")
+        self._t0 = _monotonic()
+        self._next_due = self._t0 + self.interval_s
+        self._tick_lock = threading.Lock()  # thread tick vs close flush
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._timeline: "deque[Dict[str, Any]]" = deque(maxlen=timeline_max)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"sparkdl-telemetry-export-{self.tel.run_id}")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            wait_s = max(0.005, min(self._next_due - _monotonic(),
+                                    self.interval_s))
+            if self._stop.wait(timeout=wait_s):
+                return
+            self.tick_if_due()
+
+    def close(self) -> None:
+        """Stop the thread, then flush one final snapshot — the tail of
+        the run (where failures live) is never lost to cadence."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        self.tick(final=True)
+
+    # -- ticking -------------------------------------------------------------
+
+    def tick_if_due(self) -> bool:
+        """Export iff the cadence clock says a snapshot is due."""
+        now = _monotonic()
+        if now < self._next_due:
+            return False
+        self._next_due = now + self.interval_s
+        self.tick()
+        return True
+
+    def tick(self, final: bool = False) -> None:
+        """One export. Never raises: a crashed tick records ONE
+        ``telemetry_export_error`` health event and returns, so the
+        exporter thread survives and the next tick gets a fresh try."""
+        from sparkdl_tpu.core import health  # lazy: health imports us
+
+        try:
+            with self._tick_lock:
+                self._export(final=final)
+        except Exception as e:  # noqa: BLE001 - recorded, never re-raised
+            self.errors += 1
+            health.record(health.TELEMETRY_EXPORT_ERROR,
+                          error=type(e).__name__, seq=self.seq)
+            logging.getLogger(__name__).exception(
+                "telemetry snapshot export failed (seq %d): %s",
+                self.seq, e)
+
+    def _export(self, final: bool) -> None:
+        now = _monotonic()
+        tel = self.tel
+        self.seq += 1
+        slo_state = (self.watchdog.evaluate(tel.metrics, now=now)
+                     if self.watchdog is not None else None)
+        snap: Dict[str, Any] = {
+            "seq": self.seq,
+            "run_id": tel.run_id,
+            "uptime_s": round(now - self._t0, 6),
+            "created_unix_s": round(time.time(), 3),
+            "windowed": tel.metrics.window_snapshot(),
+            "cumulative": tel.metrics.snapshot(),
+            "executor": self._executor_status(),
+        }
+        if slo_state is not None:
+            snap["slo"] = slo_state
+        if final:
+            snap["final"] = True
+        self._timeline.append(self._compact(snap))
+        if self.snapshot_path is not None:
+            with open(self.snapshot_path, "a") as f:
+                f.write(json.dumps(snap, default=str) + "\n")
+                f.flush()
+        if self.prom_path is not None:
+            tmp = self.prom_path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(tel.metrics.prometheus_text())
+            os.replace(tmp, self.prom_path)
+
+    @staticmethod
+    def _executor_status() -> Optional[Dict[str, Any]]:
+        """Queue/breaker state of the device execution service — read
+        only when the process already imported it (``sys.modules``, not
+        an import: a pure-training job must not pay for the executor
+        just because the exporter is on)."""
+        import sys
+
+        mod = sys.modules.get("sparkdl_tpu.core.executor")
+        if mod is None:
+            return None
+        return mod.service().status()
+
+    # -- the timeline that feeds RunReport -----------------------------------
+
+    @staticmethod
+    def _compact(snap: Dict[str, Any]) -> Dict[str, Any]:
+        """One bounded timeline entry per snapshot: windowed activity
+        (non-empty instruments only) + the SLO verdicts."""
+        windowed = snap["windowed"]
+        entry: Dict[str, Any] = {
+            "seq": snap["seq"],
+            "uptime_s": snap["uptime_s"],
+            "windowed_histograms": {
+                k: {"count": v["count"], "p50": v["p50"], "p99": v["p99"]}
+                for k, v in windowed["histograms"].items() if v["count"]},
+            "windowed_counters": {
+                k: v for k, v in windowed["counters"].items()
+                if v["count"]},
+        }
+        if snap.get("slo") is not None:
+            entry["slo_breached"] = sorted(
+                name for name, st in snap["slo"].items() if st["breached"])
+        if snap.get("final"):
+            entry["final"] = True
+        return entry
+
+    def timeline_summary(self) -> Dict[str, Any]:
+        """The run report's ``timeline`` block: exporter stats + the
+        (bounded, tail-keeping) compact snapshot entries."""
+        return {
+            "export_interval_s": self.interval_s,
+            "snapshots": self.seq,
+            "errors": self.errors,
+            "snapshot_path": self.snapshot_path,
+            "prometheus_path": self.prom_path,
+            "entries": list(self._timeline),
+        }
 
 
 # ---------------------------------------------------------------------------
@@ -594,13 +1067,42 @@ class Telemetry:
     """
 
     def __init__(self, name: str = "run", out_dir: Optional[str] = None,
-                 max_spans: int = 65536) -> None:
+                 max_spans: int = 65536,
+                 window_s: Optional[float] = 60.0,
+                 window_buckets: int = 12,
+                 export_interval_s: Optional[float] = None,
+                 slo_rules: Optional[Sequence[Any]] = None) -> None:
         self.name = name
         self.out_dir = (out_dir if out_dir is not None
                         else os.environ.get(TELEMETRY_DIR_ENV))
         self.run_id = f"{name}-{os.getpid():x}-{next(_run_counter):04x}"
         self.tracer = Tracer(trace_id=self.run_id, max_spans=max_spans)
-        self.metrics = MetricsRegistry()
+        self.metrics = MetricsRegistry(window_s=window_s,
+                                       window_buckets=window_buckets)
+        if export_interval_s is None:
+            env = os.environ.get(EXPORT_INTERVAL_ENV)
+            export_interval_s = float(env) if env else None
+        if export_interval_s is not None and export_interval_s <= 0:
+            raise ValueError("export_interval_s must be > 0, got "
+                             f"{export_interval_s!r}")
+        self.export_interval_s = export_interval_s
+        if slo_rules is not None and window_s is not None:
+            # an EXPLICIT rule window past the ring capacity would
+            # silently evaluate over less history than it declares —
+            # fail here, where both configs are in hand, not at the
+            # first tick. (The shipped defaults adapt instead: a scope
+            # with a small ring gets them re-parameterized to fit.)
+            for rule in slo_rules:
+                if rule.window_s > window_s + 1e-9:
+                    raise ValueError(
+                        f"SLO rule {rule.name!r} window_s="
+                        f"{rule.window_s} exceeds this scope's metric "
+                        f"ring capacity (window_s={window_s}); raise "
+                        "Telemetry(window_s=...) or shrink the rule "
+                        "window")
+        self.slo_rules = slo_rules
+        self.slo_watchdog: Any = None
+        self.exporter: Optional[SnapshotExporter] = None
         self._prev: Optional["Telemetry"] = None
         self._root: Optional[_Span] = None
         self._prev_factory: Any = None
@@ -638,10 +1140,33 @@ class Telemetry:
         self._root = self.tracer.span(SPAN_RUN, parent=ROOT,
                                       run=self.name)
         self._root.__enter__()
+        if self.export_interval_s is not None:
+            # lazy: core.slo imports this module for the metric catalog
+            from sparkdl_tpu.core import slo as _slo
+
+            rules = self.slo_rules
+            if rules is None:
+                cap = self.metrics.window_s
+                if cap is not None and cap < _slo.DEFAULT_WINDOW_S:
+                    # the defaults adapt to a smaller metric ring
+                    # instead of refusing the scope
+                    rules = _slo.default_rules(window_s=cap)
+                else:
+                    rules = _slo.DEFAULT_RULES
+            self.slo_watchdog = _slo.SLOWatchdog(rules) if rules else None
+            self.exporter = SnapshotExporter(
+                self, self.export_interval_s, out_dir=self.out_dir,
+                watchdog=self.slo_watchdog)
+            self.exporter.start()
         return self
 
     def __exit__(self, *exc: Any) -> None:
         global _active
+        if self.exporter is not None:
+            # stop + final drop-safe flush BEFORE deactivating: SLO
+            # events from the last evaluation still mirror into THIS
+            # scope's counters and the active HealthMonitor
+            self.exporter.close()
         if self._root is not None:
             # pass the unwinding exception through so the run root span
             # carries the error attribute like every interior span
@@ -774,4 +1299,8 @@ class RunReport:
             "phases": _profiling.phase_stats(),
             "overlap": _profiling.overlap_stats(),
             "health": mon.report() if mon is not None else None,
+            # the live plane's view of the same run: one compact entry
+            # per periodic snapshot (None without an exporter)
+            "timeline": (tel.exporter.timeline_summary()
+                         if tel.exporter is not None else None),
         }

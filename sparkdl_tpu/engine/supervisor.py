@@ -563,6 +563,12 @@ class PartitionSupervisor:
             task.deadline_failed = True
             task.cancel_event.set()  # abandoned attempts bail quietly
             elapsed = now - started
+            task.duration = elapsed
+            # watchdog kills feed the duration histogram too: the
+            # sliding-window task-duration view (docs/OBSERVABILITY.md
+            # "Live metrics & SLOs") must show the stall tail, not just
+            # the tasks that resolved on their own
+            telemetry.observe(telemetry.M_TASK_DURATION_S, elapsed)
             cause = resilience.DeadlineExceeded(
                 f"partition {task.index} task exceeded its {timeout}s "
                 f"deadline ({elapsed:.2f}s elapsed)")

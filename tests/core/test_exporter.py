@@ -1,0 +1,175 @@
+"""Periodic snapshot exporter lifecycle (ISSUE 7): cadence under a fake
+clock, drop-safe final flush at scope exit, no thread leak after
+Telemetry teardown, and a crashed tick that records a health event
+instead of dying silently."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from sparkdl_tpu.core import health, telemetry
+from sparkdl_tpu.core.health import HealthMonitor
+from sparkdl_tpu.core.telemetry import SnapshotExporter, Telemetry
+
+
+class _FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _lines(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f]
+
+
+def test_snapshots_appear_at_cadence_under_fake_clock(tmp_path,
+                                                      monkeypatch):
+    """Ticks export exactly when the cadence clock says a snapshot is
+    due — no early, no duplicate — and each line carries the sequence
+    number, the windowed + cumulative views and the executor state."""
+    clock = _FakeClock()
+    monkeypatch.setattr(telemetry, "_monotonic", clock)
+    with Telemetry("cadence", window_s=10.0, window_buckets=10) as tel:
+        exp = SnapshotExporter(tel, interval_s=1.0, out_dir=str(tmp_path))
+        telemetry.observe(telemetry.M_QUEUE_WAIT_S, 0.01)
+        assert not exp.tick_if_due()      # t+0: not due yet
+        clock.advance(0.5)
+        assert not exp.tick_if_due()      # half an interval: still not
+        clock.advance(0.6)
+        assert exp.tick_if_due()          # t+1.1: first snapshot
+        assert not exp.tick_if_due()      # immediately after: not due
+        clock.advance(2.5)
+        assert exp.tick_if_due()          # due again
+        exp.close()                       # final flush (third line)
+    lines = _lines(exp.snapshot_path)
+    assert [line["seq"] for line in lines] == [1, 2, 3]
+    assert lines[-1]["final"] is True
+    assert lines[0]["uptime_s"] == pytest.approx(1.1)
+    for line in lines:
+        assert "windowed" in line and "cumulative" in line
+        assert "executor" in line
+        assert line["run_id"] == tel.run_id
+    qw = telemetry.M_QUEUE_WAIT_S
+    assert lines[0]["windowed"]["histograms"][qw]["count"] == 1
+    assert lines[0]["cumulative"]["histograms"][qw]["count"] == 1
+
+
+def test_exporter_thread_cadence_final_flush_and_no_leak(tmp_path):
+    """The real daemon thread: snapshots accumulate at the configured
+    interval, scope exit flushes one final snapshot, and no exporter
+    thread survives Telemetry teardown."""
+    with Telemetry("live", out_dir=str(tmp_path),
+                   export_interval_s=0.05) as tel:
+        exp = tel.exporter
+        assert exp is not None
+        assert exp._thread is not None and exp._thread.is_alive()
+        assert exp._thread.daemon
+        deadline = time.monotonic() + 10.0
+        while exp.seq < 3 and time.monotonic() < deadline:
+            telemetry.observe(telemetry.M_TASK_DURATION_S, 0.01)
+            time.sleep(0.01)
+        assert exp.seq >= 3
+    # teardown: the thread is gone — nothing named like the exporter
+    assert not any("sparkdl-telemetry-export" in t.name
+                   for t in threading.enumerate())
+    lines = _lines(exp.snapshot_path)
+    assert lines[-1]["final"] is True
+    assert [line["seq"] for line in lines] == \
+        list(range(1, len(lines) + 1))
+    # the Prometheus file landed atomically and is a valid exposition
+    text = open(exp.prom_path).read()
+    assert "# HELP" in text and "# TYPE" in text
+    assert "sparkdl_task_duration_s_count" in text
+    # the run report carries the timeline derived from the snapshots
+    report = tel.report()
+    assert report["timeline"]["snapshots"] == len(lines)
+    assert report["timeline"]["entries"][-1]["final"] is True
+    assert report["timeline"]["errors"] == 0
+
+
+def test_long_interval_scope_still_flushes_final_snapshot(tmp_path):
+    """A scope shorter than one export interval still writes its final
+    state: the shutdown flush is drop-safe, not best-effort."""
+    with Telemetry("short", out_dir=str(tmp_path),
+                   export_interval_s=300.0) as tel:
+        telemetry.count("sparkdl.health.executor_shed", 2)
+    lines = _lines(tel.exporter.snapshot_path)
+    assert len(lines) == 1
+    assert lines[0]["seq"] == 1 and lines[0]["final"] is True
+    shed = telemetry.HEALTH_METRIC_PREFIX + health.EXECUTOR_SHED
+    assert lines[0]["cumulative"]["counters"][shed] == 2
+
+
+def test_crashed_tick_records_health_event_and_survives(tmp_path,
+                                                        monkeypatch):
+    """A tick that raises records ONE telemetry_export_error health
+    event (mirrored into the scope's counters) and the exporter keeps
+    working afterwards — it never dies silently."""
+    with HealthMonitor("crash") as mon:
+        with Telemetry("boom", out_dir=str(tmp_path),
+                       export_interval_s=300.0) as tel:
+            exp = tel.exporter
+            orig_export = exp._export
+
+            def explode(final=False):
+                raise RuntimeError("disk full")
+
+            monkeypatch.setattr(exp, "_export", explode)
+            exp.tick()                     # must not raise
+            assert exp.errors == 1 and exp.seq == 0
+            assert mon.count(health.TELEMETRY_EXPORT_ERROR) == 1
+            monkeypatch.setattr(exp, "_export", orig_export)
+            exp.tick()                     # healthy again
+            assert exp.seq == 1 and exp.errors == 1
+    report = tel.report()
+    assert report["timeline"]["errors"] == 1
+    assert report["metrics"]["counters"][
+        telemetry.HEALTH_METRIC_PREFIX
+        + health.TELEMETRY_EXPORT_ERROR] == 1
+    # the final close flush still landed (seq 2: tick + final)
+    assert report["timeline"]["snapshots"] == 2
+
+
+def test_no_exporter_without_interval_and_validation(tmp_path,
+                                                     monkeypatch):
+    monkeypatch.delenv(telemetry.EXPORT_INTERVAL_ENV, raising=False)
+    with Telemetry("quiet") as tel:
+        assert tel.exporter is None
+    assert tel.report()["timeline"] is None
+    with pytest.raises(ValueError, match="export_interval_s"):
+        Telemetry("bad", export_interval_s=0.0)
+    with Telemetry("manual") as tel2:
+        with pytest.raises(ValueError, match="export_interval_s"):
+            SnapshotExporter(tel2, interval_s=-1.0,
+                             out_dir=str(tmp_path))
+
+
+def test_export_interval_env_opt_in(tmp_path, monkeypatch):
+    monkeypatch.setenv(telemetry.TELEMETRY_DIR_ENV, str(tmp_path))
+    monkeypatch.setenv(telemetry.EXPORT_INTERVAL_ENV, "0.05")
+    with Telemetry("envjob") as tel:
+        assert tel.export_interval_s == 0.05
+        assert tel.exporter is not None
+    assert len(_lines(tel.exporter.snapshot_path)) >= 1
+
+
+def test_exporter_without_out_dir_keeps_timeline_only(monkeypatch):
+    """No out_dir: no files, but ticks still feed the in-memory timeline
+    (and the SLO watchdog) — the live plane works programmatically."""
+    monkeypatch.delenv(telemetry.TELEMETRY_DIR_ENV, raising=False)
+    with Telemetry("mem", out_dir=None,
+                   export_interval_s=300.0) as tel:
+        assert tel.exporter.snapshot_path is None
+        assert tel.exporter.prom_path is None
+        tel.exporter.tick()
+    report = tel.report()
+    assert report["timeline"]["snapshots"] == 2  # tick + final flush
+    assert report["timeline"]["snapshot_path"] is None

@@ -1,0 +1,271 @@
+"""SLO watchdog (ISSUE 7): declarative rules over the sliding-window
+metric plane — construction-time validation, breach/recovery pairing
+with hold-down, windowed-not-cumulative verdicts, default rules."""
+
+import json
+import time
+
+import pytest
+
+from sparkdl_tpu.core import health, slo, telemetry
+from sparkdl_tpu.core.health import HealthMonitor
+from sparkdl_tpu.core.slo import SLORule, SLOWatchdog
+from sparkdl_tpu.core.telemetry import Telemetry
+
+_SHED = telemetry.HEALTH_METRIC_PREFIX + health.EXECUTOR_SHED
+
+
+class _FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@pytest.fixture
+def clock(monkeypatch):
+    c = _FakeClock()
+    monkeypatch.setattr(telemetry, "_monotonic", c)
+    return c
+
+
+def _scope():
+    return Telemetry("slo-test", window_s=10.0, window_buckets=10)
+
+
+# -- rule validation ---------------------------------------------------------
+
+def test_rule_validation_rejects_typos_and_bad_fields():
+    good = dict(window_s=1.0, threshold=1.0)
+    SLORule("ok", metric=telemetry.M_QUEUE_WAIT_S, **good)
+    SLORule("ok2", metric=_SHED, stat="rate_per_s", **good)
+    with pytest.raises(ValueError, match="not a declared name"):
+        SLORule("typo", metric="sparkdl.executor.queue_wait_ss", **good)
+    with pytest.raises(ValueError, match="not a declared name"):
+        SLORule("typo2", metric="sparkdl.health.executor_shedd", **good)
+    with pytest.raises(ValueError, match="comparator"):
+        SLORule("c", metric=telemetry.M_QUEUE_WAIT_S, comparator="!=",
+                **good)
+    with pytest.raises(ValueError, match="stat"):
+        SLORule("s", metric=telemetry.M_QUEUE_WAIT_S, stat="p42", **good)
+    with pytest.raises(ValueError, match="window_s"):
+        SLORule("w", metric=telemetry.M_QUEUE_WAIT_S, window_s=0.0,
+                threshold=1.0)
+    with pytest.raises(ValueError, match="for_s"):
+        SLORule("f", metric=telemetry.M_QUEUE_WAIT_S, for_s=-1.0, **good)
+    rule = SLORule("dup", metric=telemetry.M_QUEUE_WAIT_S, **good)
+    with pytest.raises(ValueError, match="duplicate"):
+        SLOWatchdog([rule, rule])
+
+
+def test_rule_validation_rejects_stat_kind_mismatch():
+    """A stat the metric's instrument kind can never produce must fail
+    at construction — it would observe None forever and watch nothing."""
+    good = dict(window_s=1.0, threshold=1.0)
+    # p99 of a health mirror (always a counter): rejected
+    with pytest.raises(ValueError, match="cannot be observed"):
+        SLORule("shed_p99", metric=_SHED, stat="p99", **good)
+    # counter stats on a histogram work (count/rate are merged views)
+    SLORule("qw_rate", metric=telemetry.M_QUEUE_WAIT_S,
+            stat="rate_per_s", **good)
+    # gauge value on a histogram: rejected
+    with pytest.raises(ValueError, match="cannot be observed"):
+        SLORule("qw_value", metric=telemetry.M_QUEUE_WAIT_S,
+                stat="value", **good)
+    # histogram stats on a gauge: rejected
+    with pytest.raises(ValueError, match="cannot be observed"):
+        SLORule("depth_p99", metric=telemetry.M_EXECUTOR_QUEUE_DEPTH,
+                stat="p99", **good)
+    # every canonical metric has a declared kind (the map is total)
+    assert set(telemetry.CANONICAL_METRIC_KINDS) == \
+        set(telemetry.CANONICAL_METRIC_NAMES)
+
+
+def test_scope_rejects_rule_window_past_ring_capacity(tmp_path):
+    """A rule window the metric ring cannot answer fails at scope
+    construction, not silently capped at the first tick — and a
+    standalone watchdog over an undersized registry warns (once)
+    instead of silently judging over less history."""
+    wide = SLORule("qw", metric=telemetry.M_QUEUE_WAIT_S, window_s=300.0,
+                   threshold=1.0, stat="p99")
+    with pytest.raises(ValueError, match="ring capacity"):
+        Telemetry("bad", out_dir=str(tmp_path), export_interval_s=1.0,
+                  window_s=60.0, slo_rules=[wide])
+    # standalone: evaluates over the capped window, with a warning
+    with Telemetry("standalone", window_s=10.0, window_buckets=10) as tel:
+        wd = SLOWatchdog([wide])
+        out = wd.evaluate(tel.metrics)
+        assert out["qw"]["breached"] is False
+        assert "qw" in wd._capacity_warned
+    # the shipped DEFAULTS adapt instead of refusing the scope: a small
+    # ring re-parameterizes them to its capacity
+    with Telemetry("small-ring", export_interval_s=300.0,
+                   window_s=5.0, window_buckets=10) as tel2:
+        assert [r.window_s for r in tel2.slo_watchdog.rules] == [5.0] * 3
+        assert {r.name for r in tel2.slo_watchdog.rules} == \
+            {r.name for r in slo.DEFAULT_RULES}
+
+
+# -- breach / recovery pairing -----------------------------------------------
+
+def test_breach_and_recovery_pair_exactly_once(clock):
+    rule = SLORule("qw", metric=telemetry.M_QUEUE_WAIT_S, window_s=2.0,
+                   threshold=0.1, stat="p99")
+    with HealthMonitor() as mon, _scope() as tel:
+        wd = SLOWatchdog([rule])
+        # no data is never a breach: a quiet executor pages nobody
+        assert wd.evaluate(tel.metrics)["qw"]["breached"] is False
+        telemetry.observe(telemetry.M_QUEUE_WAIT_S, 5.0)
+        out = wd.evaluate(tel.metrics)
+        assert out["qw"]["breached"] is True
+        assert out["qw"]["observed"] == pytest.approx(5.0)
+        wd.evaluate(tel.metrics)   # still breached: no second event
+        assert mon.count(health.SLO_BREACH) == 1
+        clock.advance(30.0)        # the spike ages out of the window
+        assert wd.evaluate(tel.metrics)["qw"]["breached"] is False
+        wd.evaluate(tel.metrics)   # stays recovered: no second event
+        assert wd.state()["qw"]["breached"] is False
+    assert mon.count(health.SLO_BREACH) == 1
+    assert mon.count(health.SLO_RECOVERED) == 1
+    # the alert payload: rule name, observed value, threshold
+    (breach,) = mon.events(health.SLO_BREACH)
+    assert breach["rule"] == "qw"
+    assert breach["observed"] == pytest.approx(5.0)
+    assert breach["threshold"] == 0.1
+    assert breach["metric"] == telemetry.M_QUEUE_WAIT_S
+    (rec,) = mon.events(health.SLO_RECOVERED)
+    assert rec["rule"] == "qw"
+    # mirrored into the scope's counters at the health choke point
+    assert tel.metrics.counter(
+        telemetry.HEALTH_METRIC_PREFIX + health.SLO_BREACH).value == 1
+    assert tel.metrics.counter(
+        telemetry.HEALTH_METRIC_PREFIX + health.SLO_RECOVERED).value == 1
+
+
+def test_hold_down_requires_continuous_breach(clock):
+    rule = SLORule("shed", metric=_SHED, window_s=5.0, threshold=0.5,
+                   comparator=">=", stat="rate_per_s", for_s=1.0)
+    with HealthMonitor() as mon, _scope() as tel:
+        wd = SLOWatchdog([rule])
+        telemetry.count(_SHED, 10)
+        wd.evaluate(tel.metrics)            # breaching, held 0 s
+        assert mon.count(health.SLO_BREACH) == 0
+        clock.advance(0.5)
+        wd.evaluate(tel.metrics)            # held 0.5 s < for_s
+        assert mon.count(health.SLO_BREACH) == 0
+        clock.advance(0.6)
+        wd.evaluate(tel.metrics)            # held 1.1 s >= for_s: fires
+        assert mon.count(health.SLO_BREACH) == 1
+    assert mon.count(health.SLO_RECOVERED) == 0  # never recovered in-scope
+
+
+def test_transient_blip_shorter_than_hold_down_never_fires(clock):
+    rule = SLORule("shed", metric=_SHED, window_s=2.0, threshold=0.5,
+                   comparator=">=", stat="rate_per_s", for_s=5.0)
+    with HealthMonitor() as mon, _scope() as tel:
+        wd = SLOWatchdog([rule])
+        telemetry.count(_SHED, 10)
+        wd.evaluate(tel.metrics)            # breaching, pending
+        clock.advance(3.0)                  # blip ages out before for_s
+        wd.evaluate(tel.metrics)            # back in budget: pending reset
+        telemetry.count(_SHED, 10)          # a second, separate blip
+        wd.evaluate(tel.metrics)
+        clock.advance(3.0)
+        wd.evaluate(tel.metrics)
+    # two blips, neither held for 5 s: no breach, and no recovery either
+    assert mon.count(health.SLO_BREACH) == 0
+    assert mon.count(health.SLO_RECOVERED) == 0
+
+
+def test_floor_comparator_on_gauge_value(clock):
+    """'<' rules state throughput floors: a gauge below target breaches,
+    back above recovers."""
+    rule = SLORule("ingest_floor", metric=telemetry.M_EXAMPLES_PER_SEC,
+                   window_s=5.0, threshold=100.0, comparator="<",
+                   stat="value")
+    with HealthMonitor() as mon, _scope() as tel:
+        wd = SLOWatchdog([rule])
+        telemetry.gauge_set(telemetry.M_EXAMPLES_PER_SEC, 50.0)
+        assert wd.evaluate(tel.metrics)["ingest_floor"]["breached"]
+        telemetry.gauge_set(telemetry.M_EXAMPLES_PER_SEC, 500.0)
+        assert not wd.evaluate(tel.metrics)["ingest_floor"]["breached"]
+    assert mon.count(health.SLO_BREACH) == 1
+    assert mon.count(health.SLO_RECOVERED) == 1
+
+
+def test_windowed_not_cumulative_verdict(clock):
+    """An old spike outside the rule window must NOT breach — the exact
+    '10-minute-old p99 pollutes current' failure this plane removes."""
+    rule = SLORule("qw", metric=telemetry.M_QUEUE_WAIT_S, window_s=2.0,
+                   threshold=0.1, stat="p99")
+    with HealthMonitor() as mon, _scope() as tel:
+        telemetry.observe(telemetry.M_QUEUE_WAIT_S, 5.0)  # the spike
+        clock.advance(60.0)                               # long ago now
+        wd = SLOWatchdog([rule])
+        out = wd.evaluate(tel.metrics)
+        assert out["qw"]["observed"] is None
+        assert out["qw"]["breached"] is False
+        # while the cumulative view still reports the spike
+        assert tel.metrics.snapshot()["histograms"][
+            telemetry.M_QUEUE_WAIT_S]["p99"] == pytest.approx(5.0)
+    assert mon.count(health.SLO_BREACH) == 0
+
+
+# -- default rules -----------------------------------------------------------
+
+def test_default_rules_cover_the_overload_story():
+    by_name = {r.name: r for r in slo.DEFAULT_RULES}
+    assert set(by_name) == {"executor_queue_wait_p99",
+                            "executor_shed_rate",
+                            "executor_breaker_open"}
+    assert by_name["executor_queue_wait_p99"].metric == \
+        telemetry.M_QUEUE_WAIT_S
+    assert by_name["executor_shed_rate"].metric == _SHED
+    assert by_name["executor_breaker_open"].metric == \
+        telemetry.HEALTH_METRIC_PREFIX + health.BREAKER_OPEN
+    # re-parameterized copies keep the same shape
+    custom = slo.default_rules(window_s=1.5, for_s=0.25)
+    assert {r.name for r in custom} == set(by_name)
+    assert all(r.window_s == 1.5 and r.for_s == 0.25 for r in custom)
+
+
+def test_breaker_open_default_rule_fires_on_trip(clock):
+    rules = slo.default_rules(window_s=1.0)
+    with HealthMonitor() as mon, _scope() as tel:
+        wd = SLOWatchdog(rules)
+        telemetry.count(telemetry.HEALTH_METRIC_PREFIX
+                        + health.BREAKER_OPEN)
+        out = wd.evaluate(tel.metrics)
+        assert out["executor_breaker_open"]["breached"] is True
+        clock.advance(10.0)
+        assert not wd.evaluate(
+            tel.metrics)["executor_breaker_open"]["breached"]
+    assert mon.count(health.SLO_BREACH) == 1
+    assert mon.count(health.SLO_RECOVERED) == 1
+    assert mon.events(health.SLO_BREACH)[0]["rule"] == \
+        "executor_breaker_open"
+
+
+# -- scope integration -------------------------------------------------------
+
+def test_scope_wires_watchdog_into_exporter_snapshots(tmp_path):
+    rules = slo.default_rules(window_s=1.0)
+    with Telemetry("wired", out_dir=str(tmp_path),
+                   export_interval_s=0.02, window_s=2.0,
+                   window_buckets=10, slo_rules=rules) as tel:
+        assert tel.slo_watchdog is not None
+        assert tel.slo_watchdog.rules == tuple(rules)
+        deadline = time.monotonic() + 5.0
+        while tel.exporter.seq < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+    with open(tel.exporter.snapshot_path) as f:
+        lines = [json.loads(line) for line in f]
+    assert len(lines) >= 2
+    for line in lines:
+        assert set(line["slo"]) == {r.name for r in rules}
+        for verdict in line["slo"].values():
+            assert verdict["breached"] is False  # quiet run: no paging

@@ -36,9 +36,12 @@ def _by_id(spans):
 
 # -- zero-overhead no-op path ------------------------------------------------
 
-def test_inactive_path_is_allocation_free_noop():
+def test_inactive_path_is_allocation_free_noop(monkeypatch):
     """No scope: span() returns the SHARED singleton (no allocation), the
-    metric helpers are pure no-ops, and nothing is ever recorded."""
+    metric helpers are pure no-ops, and nothing is ever recorded —
+    including the windowed plane (ISSUE 7): with telemetry inactive the
+    record path never even reaches an instrument, and a ring-free
+    instrument (the bare default) records without reading the clock."""
     assert telemetry.active() is None
     s1 = telemetry.span("sparkdl.task")
     s2 = telemetry.span("sparkdl.fit", anything=1)
@@ -49,12 +52,33 @@ def test_inactive_path_is_allocation_free_noop():
     telemetry.count("sparkdl.health.task_retried")
     telemetry.gauge_set(telemetry.M_PADDING_WASTE, 0.5)
     telemetry.observe(telemetry.M_STEP_TIME_S, 0.1)
+    # unwindowed instruments never touch the window clock on the record
+    # path — the windowed-metric feature costs the no-ring path nothing
+    def clock_read_is_a_bug():
+        raise AssertionError("ring-free record path read the window clock")
+
+    monkeypatch.setattr(telemetry, "_monotonic", clock_read_is_a_bug)
+    h = Histogram("h")
+    h.observe(0.25)
+    c = telemetry.Counter("c")
+    c.inc()
+    g = telemetry.Gauge("g")
+    g.set(1.0)
+    # their windowed views are inert, not wrong
+    assert c.window_count(10.0) == 0
+    assert g.window_values(10.0) is None
+    w = h.window_snapshot(10.0)
+    assert w["count"] == 0 and w["p50"] is None and w["p99"] is None
+    monkeypatch.setattr(telemetry, "_monotonic", time.monotonic)
     # a scope opened AFTER the no-ops sees none of them
     with Telemetry("after") as tel:
         pass
     snap = tel.metrics.snapshot()
     assert snap["counters"] == {} and snap["histograms"] == {}
     assert [s["name"] for s in tel.tracer.spans()] == ["sparkdl.run"]
+    # and its windowed snapshot is just as empty
+    wsnap = tel.metrics.window_snapshot()
+    assert wsnap["counters"] == {} and wsnap["histograms"] == {}
 
 
 def test_annotate_without_scope_unchanged():
@@ -327,6 +351,182 @@ def test_prometheus_text_exposition():
     assert 'sparkdl_task_duration_s_bucket{le="1.0"} 2' in text  # cumulative
     assert 'sparkdl_task_duration_s_bucket{le="+Inf"} 3' in text
     assert "sparkdl_task_duration_s_count 3" in text
+
+
+# -- sliding-window metrics (ISSUE 7) ----------------------------------------
+
+class _FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@pytest.fixture
+def fake_clock(monkeypatch):
+    clock = _FakeClock()
+    monkeypatch.setattr(telemetry, "_monotonic", clock)
+    return clock
+
+
+def test_windowed_counter_rotation_and_expiry(fake_clock):
+    reg = MetricsRegistry(window_s=10.0, window_buckets=10)  # 1 s slots
+    c = reg.counter("sparkdl.health.executor_shed")
+    c.inc(3)
+    fake_clock.advance(1.0)
+    c.inc(2)
+    assert c.window_count(10.0) == 5
+    assert c.window_count(1.0) == 2   # only the current slot
+    fake_clock.advance(8.0)           # first inc is 9 s old: still in
+    assert c.window_count(10.0) == 5
+    fake_clock.advance(1.0)           # 10 s: the first slot ages out
+    assert c.window_count(10.0) == 2
+    fake_clock.advance(1.0)           # 11 s: everything aged out
+    assert c.window_count(10.0) == 0
+    assert c.value == 5               # the cumulative view is untouched
+    # a slot index reused after a full ring revolution is reset first —
+    # no ghost counts from the previous epoch
+    fake_clock.advance(100.0)
+    c.inc(1)
+    assert c.window_count(10.0) == 1
+    assert c.value == 6
+
+
+def test_windowed_gauge_envelope(fake_clock):
+    reg = MetricsRegistry(window_s=10.0, window_buckets=10)
+    g = reg.gauge("sparkdl.executor.queue_depth")
+    g.set(5)
+    g.set(2)                          # same slot: last=2, min=2, max=5
+    fake_clock.advance(1.0)
+    g.set(9)
+    assert g.window_values(10.0) == {"last": 9.0, "min": 2.0, "max": 9.0}
+    fake_clock.advance(20.0)          # window empty
+    assert g.window_values(10.0) is None
+    assert g.value == 9.0             # cumulative last-write survives
+
+
+def test_windowed_histogram_percentiles_and_aging(fake_clock):
+    reg = MetricsRegistry(window_s=10.0, window_buckets=10)
+    h = reg.histogram("sparkdl.executor.queue_wait_s")
+    for _ in range(50):
+        h.observe(0.01)
+    for _ in range(50):
+        h.observe(0.5)
+    w = h.window_snapshot(10.0)
+    assert w["count"] == 100
+    assert w["rate_per_s"] == pytest.approx(10.0)
+    assert w["min"] == 0.01 and w["max"] == 0.5
+    assert 0.01 / 2 <= w["p50"] <= 0.01 * 2    # factor-2 bucket bound
+    assert 0.5 / 2 <= w["p99"] <= 0.5
+    # the spike ages out of the window but stays in the cumulative view:
+    # "current p99" stops being polluted by history (the ISSUE 7 motive)
+    fake_clock.advance(30.0)
+    w2 = h.window_snapshot(10.0)
+    assert w2["count"] == 0 and w2["sum"] == 0.0
+    assert w2["min"] is None and w2["max"] is None
+    assert w2["p50"] is None and w2["p95"] is None and w2["p99"] is None
+    cum = h.snapshot()
+    assert cum["count"] == 100 and cum["p99"] is not None
+
+
+def test_registry_window_snapshot_shape_defaults_and_clamp(fake_clock):
+    reg = MetricsRegistry(window_s=10.0, window_buckets=10)
+    reg.counter("sparkdl.health.executor_shed").inc(4)
+    reg.gauge("sparkdl.executor.queue_depth").set(3)
+    reg.histogram("sparkdl.executor.queue_wait_s").observe(0.2)
+    snap = reg.window_snapshot()          # default: the full ring
+    assert snap["window_s"] == 10.0
+    assert snap["counters"]["sparkdl.health.executor_shed"] == \
+        {"count": 4, "rate_per_s": 0.4}
+    assert snap["gauges"]["sparkdl.executor.queue_depth"]["last"] == 3.0
+    assert snap["histograms"]["sparkdl.executor.queue_wait_s"]["count"] == 1
+    json.dumps(snap)                      # JSON-able end to end
+    # a query past the ring capacity clamps to it (can't answer more)
+    assert reg.window_snapshot(1e9)["window_s"] == 10.0
+    # a non-positive window is a caller bug, not a division crash
+    with pytest.raises(ValueError, match="window_s"):
+        reg.window_snapshot(0.0)
+    # a bare registry (no windows) answers with empty sections
+    bare = MetricsRegistry()
+    assert bare.window_snapshot() == {
+        "window_s": None, "counters": {}, "gauges": {}, "histograms": {}}
+    with pytest.raises(ValueError):
+        MetricsRegistry(window_s=0.0)
+
+
+def test_histogram_snapshot_empty_percentiles_are_null():
+    """ISSUE 7 satellite: an empty histogram (and an all-zero-count
+    window) reports null percentiles, never a bucket-midpoint guess."""
+    h = Histogram("h")
+    snap = h.snapshot()
+    assert snap["count"] == 0
+    assert snap["p50"] is None and snap["p95"] is None \
+        and snap["p99"] is None
+    assert snap["min"] is None and snap["max"] is None
+    # percentiles and buckets come from ONE locked copy: an empty
+    # histogram's snapshot stays internally consistent
+    assert snap["buckets"] == {}
+    json.dumps(snap)  # null, not NaN — JSON-able
+
+
+# -- prometheus exposition conformance (ISSUE 7 satellite) -------------------
+
+def test_prometheus_text_format_conformance():
+    """Every family gets exactly one # HELP and one # TYPE line before
+    its samples; every sample line parses; histogram buckets are
+    cumulative and close with +Inf == count."""
+    import re
+
+    reg = MetricsRegistry()
+    reg.counter("sparkdl.engine.rows_out").inc(3)
+    reg.gauge("sparkdl.train.examples_per_sec").set(120.5)
+    h = reg.histogram("sparkdl.task.duration_s", bounds=(0.1, 1.0))
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v)
+    text = reg.prometheus_text()
+    assert text.endswith("\n")
+    name_re = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+    sample_re = re.compile(
+        rf'^({name_re})(\{{le="[^"\n]*"\}})? (-?[0-9.e+-]+|NaN)$')
+    help_re = re.compile(rf"^# HELP ({name_re}) .+$")
+    type_re = re.compile(
+        rf"^# TYPE ({name_re}) (counter|gauge|histogram)$")
+    seen_help, seen_type = set(), set()
+    for line in text.strip().splitlines():
+        if line.startswith("# HELP"):
+            m = help_re.match(line)
+            assert m, line
+            assert m.group(1) not in seen_help, f"duplicate HELP: {line}"
+            seen_help.add(m.group(1))
+        elif line.startswith("# TYPE"):
+            m = type_re.match(line)
+            assert m, line
+            assert m.group(1) not in seen_type, f"duplicate TYPE: {line}"
+            seen_type.add(m.group(1))
+        else:
+            m = sample_re.match(line)
+            assert m, line
+            base = m.group(1)
+            family = re.sub(r"_(bucket|sum|count)$", "", base)
+            # samples only after their family's HELP + TYPE
+            assert base in seen_type or family in seen_type, line
+            assert base in seen_help or family in seen_help, line
+    assert seen_help == seen_type
+    # histogram buckets: cumulative, closing +Inf equals the count
+    assert 'sparkdl_task_duration_s_bucket{le="0.1"} 1' in text
+    assert 'sparkdl_task_duration_s_bucket{le="1.0"} 2' in text
+    assert 'sparkdl_task_duration_s_bucket{le="+Inf"} 3' in text
+    assert "sparkdl_task_duration_s_count 3" in text
+
+
+def test_prometheus_label_value_escaping():
+    assert telemetry.escape_label_value('a"b\\c\nd') == 'a\\"b\\\\c\\nd'
+    assert telemetry.escape_label_value("plain") == "plain"
+    assert telemetry.escape_label_value(0.1) == "0.1"
 
 
 # -- chrome trace export -----------------------------------------------------

@@ -538,3 +538,156 @@ def test_chaos_straggler_hedged_and_deduplicated(image_dir):
     assert mon.count(health.TASK_HEDGED) == 1
     assert mon.count(health.HEDGE_WON) == 1
     assert time.monotonic() - t0 < 1.5
+
+
+def test_chaos_overload_slo_timeline_breach_and_recovery(tmp_path):
+    """ISSUE 7 satellite: the overload/shed chaos scenario inside a
+    Telemetry scope with a short export interval. The periodic snapshot
+    timeline must show the shed-rate SLO firing during the flood and
+    recovering after — exactly one slo_breach/slo_recovered pair for
+    the violated rule — with the windowed view diverging from the
+    cumulative one once the flood ages out, and every count consistent
+    with the HealthMonitor report."""
+    import threading
+
+    import jax.numpy as jnp
+
+    from sparkdl_tpu.core import executor as device_executor
+    from sparkdl_tpu.core import slo
+    from sparkdl_tpu.core.executor import ExecutorOverloaded
+
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=(6, _FEATURES)).astype(np.float32))
+
+    def apply_fn(vs, x):
+        def host_hook(a):
+            time.sleep(0.05)  # a slow model keeps the queue full
+            return a
+        x = jax.pure_callback(host_hook,
+                              jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+        return jnp.tanh(x @ vs)
+
+    mf = ModelFunction(apply_fn, w, TensorSpec((None, 6), "float32"),
+                       name="slo_chaos")
+    device_executor.reset()
+    EngineConfig.executor_max_queued_requests = 2
+    EngineConfig.executor_overload_mode = "shed"
+    EngineConfig.coalesce_window_ms = 20.0
+    n = 16
+    inputs = [rng.normal(size=(3, 6)).astype(np.float32)
+              for _ in range(n)]
+    errors = [None] * n
+    barrier = threading.Barrier(n)
+
+    def work(i):
+        try:
+            barrier.wait()
+            device_executor.execute(mf, inputs[i], batch_size=32)
+        except BaseException as e:  # noqa: BLE001 - partitioned below
+            errors[i] = e
+
+    # second-scale windows so breach AND recovery land inside one test;
+    # the queue-wait threshold is raised so only the shed-rate rule can
+    # fire (the acceptance wants one pair per VIOLATED rule)
+    rules = slo.default_rules(window_s=0.6, shed_rate_per_s=0.5,
+                              queue_wait_p99_s=5.0)
+    tel_dir = tmp_path / "tel"
+    try:
+        with HealthMonitor("slo-chaos") as mon:
+            with Telemetry("slo-chaos", out_dir=str(tel_dir),
+                           export_interval_s=0.05, window_s=0.6,
+                           window_buckets=6, slo_rules=rules) as tel:
+                threads = [threading.Thread(target=work, args=(i,))
+                           for i in range(n)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=30.0)
+                assert not any(t.is_alive() for t in threads)
+                # the breach surfaces LIVE, on an exporter tick
+                deadline = time.monotonic() + 10.0
+                while (mon.count(health.SLO_BREACH) < 1
+                       and time.monotonic() < deadline):
+                    time.sleep(0.02)
+                assert mon.count(health.SLO_BREACH) == 1
+                # quiet down: the window slides past the flood
+                deadline = time.monotonic() + 10.0
+                while (mon.count(health.SLO_RECOVERED) < 1
+                       and time.monotonic() < deadline):
+                    time.sleep(0.02)
+                assert mon.count(health.SLO_RECOVERED) == 1
+                # queue waits are recorded at DRAIN time (later than the
+                # admission sheds), so their window empties later — wait
+                # for it so the final flush proves the windowed view is
+                # clean while the cumulative one still holds the episode
+                deadline = time.monotonic() + 10.0
+                while (tel.metrics.window_snapshot()["histograms"]
+                       .get(telemetry.M_QUEUE_WAIT_S,
+                            {"count": 0})["count"] > 0
+                       and time.monotonic() < deadline):
+                    time.sleep(0.02)
+    finally:
+        device_executor.reset()
+
+    sheds = [e for e in errors if isinstance(e, ExecutorOverloaded)]
+    assert sheds  # the flood genuinely shed past the tiny cap
+    assert all(e is None or isinstance(e, ExecutorOverloaded)
+               for e in errors), errors
+
+    # exactly one breach/recovered pair, and only for the shed rule
+    assert mon.count(health.SLO_BREACH) == 1
+    assert mon.count(health.SLO_RECOVERED) == 1
+    (breach_ev,) = mon.events(health.SLO_BREACH)
+    (rec_ev,) = mon.events(health.SLO_RECOVERED)
+    assert breach_ev["rule"] == rec_ev["rule"] == "executor_shed_rate"
+    assert breach_ev["observed"] >= 0.5
+    assert breach_ev["threshold"] == 0.5
+
+    # >= 3 periodic snapshot lines with monotone sequence numbers, and
+    # the timeline shows breach -> recovery in order
+    lines = [json.loads(line)
+             for line in open(tel.exporter.snapshot_path)]
+    assert len(lines) >= 3
+    assert [line["seq"] for line in lines] == \
+        list(range(1, len(lines) + 1))
+    breached_at = [i for i, line in enumerate(lines)
+                   if line["slo"]["executor_shed_rate"]["breached"]]
+    assert breached_at, "no snapshot captured the breach"
+    assert any(not line["slo"]["executor_shed_rate"]["breached"]
+               for line in lines[breached_at[-1] + 1:] or [lines[-1]]), \
+        "no snapshot captured the recovery"
+
+    # the windowed view diverges from the cumulative one after the
+    # flood: last-window sheds are zero while the cumulative counter
+    # still carries the episode (same for queue-wait p99 — the
+    # "current vs forever" split this plane exists for)
+    last = lines[-1]
+    shed_metric = telemetry.HEALTH_METRIC_PREFIX + health.EXECUTOR_SHED
+    assert last["windowed"]["counters"][shed_metric]["count"] == 0
+    assert last["cumulative"]["counters"][shed_metric] == len(sheds)
+    qw = telemetry.M_QUEUE_WAIT_S
+    cum_qw = last["cumulative"]["histograms"].get(qw)
+    if cum_qw and cum_qw["count"]:
+        assert last["windowed"]["histograms"][qw]["count"] == 0
+        assert last["windowed"]["histograms"][qw]["p99"] is None
+        assert cum_qw["p99"] is not None
+    # during the flood at least one snapshot saw live windowed sheds
+    assert any(line["windowed"]["counters"]
+               .get(shed_metric, {"count": 0})["count"] > 0
+               for line in lines)
+    # executor state rode along in every snapshot
+    assert all(line["executor"] is not None for line in lines)
+
+    # counts consistent with the HealthMonitor report, and the run
+    # report's mirrors agree with the monitor exactly
+    counters = mon.report()["counters"]
+    assert counters[health.EXECUTOR_SHED] == len(sheds)
+    report = json.load(open(tel.report_path))
+    for event in (health.EXECUTOR_SHED, health.SLO_BREACH,
+                  health.SLO_RECOVERED):
+        assert report["metrics"]["counters"].get(
+            telemetry.HEALTH_METRIC_PREFIX + event, 0) \
+            == counters[event], event
+    assert report["timeline"]["snapshots"] == len(lines)
+    assert any(e.get("slo_breached") == ["executor_shed_rate"]
+               for e in report["timeline"]["entries"])

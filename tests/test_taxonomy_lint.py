@@ -450,3 +450,143 @@ def test_health_record_lint_catches_typos_and_bare_strings():
     # the constants set is non-trivial and holds the canonical events
     assert "TASK_RETRIED" in _HEALTH_EVENT_CONSTANTS
     assert "BREAKER_OPEN" in _HEALTH_EVENT_CONSTANTS
+
+
+# ---------------------------------------------------------------------------
+# SLO metric-name lint (ISSUE 7): every SLORule constructed in core/slo.py
+# must name a DECLARED metric — an entry in
+# core.telemetry.CANONICAL_METRIC_NAMES or a `sparkdl.health.<event>`
+# mirror of a constant declared in core/health.py. A typo'd metric would
+# watch nothing forever; SLORule.__post_init__ enforces the same at
+# runtime, but this lint catches it before any scope ever runs (and on
+# rules built from concatenated module constants, where a typo'd constant
+# name would otherwise only surface at import time).
+# ---------------------------------------------------------------------------
+
+#: Declared health-event VALUES (the strings the mirrors are named after).
+_HEALTH_EVENT_VALUES = {
+    getattr(_health, name) for name in _HEALTH_EVENT_CONSTANTS
+}
+
+_SLO_CONST_MODULES = ("telemetry", "health", "profiling", "slo")
+_UNRESOLVED = object()  # a module-constant reference that doesn't resolve
+
+
+def _resolve_string_expr(node):
+    """Static string value of an expression: literals, telemetry./
+    health./profiling. module constants (bare names resolve too, for
+    constants referenced inside their own module), and `+`
+    concatenations of those. ``_UNRESOLVED`` for a module-constant
+    reference that does not exist (a typo'd constant); None when the
+    expression is genuinely dynamic (a local variable)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    attr = None
+    flag_missing = False
+    if isinstance(node, ast.Attribute):
+        attr = node.attr
+        flag_missing = (isinstance(node.value, ast.Name)
+                        and node.value.id in _SLO_CONST_MODULES)
+    elif isinstance(node, ast.Name):
+        attr = node.id
+    if attr is not None:
+        for mod in (_telemetry, _health, _profiling):
+            value = getattr(mod, attr, None)
+            if isinstance(value, str):
+                return value
+        return _UNRESOLVED if flag_missing else None
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        left = _resolve_string_expr(node.left)
+        right = _resolve_string_expr(node.right)
+        if left is _UNRESOLVED or right is _UNRESOLVED:
+            return _UNRESOLVED
+        if left is not None and right is not None:
+            return left + right
+    return None
+
+
+def _bad_slo_rule_metrics(tree: ast.AST):
+    """(lineno, reason) for every `SLORule(...)` whose metric argument
+    does not statically resolve to a declared metric name."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        fname = (f.id if isinstance(f, ast.Name)
+                 else f.attr if isinstance(f, ast.Attribute) else None)
+        if fname != "SLORule":
+            continue
+        metric_arg = None
+        for kw in node.keywords:
+            if kw.arg == "metric":
+                metric_arg = kw.value
+        if metric_arg is None and len(node.args) >= 2:
+            metric_arg = node.args[1]
+        if metric_arg is None:
+            out.append((node.lineno, "no metric argument"))
+            continue
+        metric = _resolve_string_expr(metric_arg)
+        if metric is _UNRESOLVED:
+            out.append((node.lineno,
+                        "metric references an undeclared module constant"))
+            continue
+        if metric is None:
+            continue  # dynamic: SLORule's runtime validation covers it
+        if metric in _telemetry.CANONICAL_METRIC_NAMES:
+            continue
+        prefix = _telemetry.HEALTH_METRIC_PREFIX
+        if (metric.startswith(prefix)
+                and metric[len(prefix):] in _HEALTH_EVENT_VALUES):
+            continue
+        out.append((node.lineno, f"undeclared metric {metric!r}"))
+    return out
+
+
+def test_every_slo_rule_metric_is_declared():
+    path = ROOT / "core" / "slo.py"
+    tree = ast.parse(path.read_text(), filename=str(path))
+    # the lint is not vacuous: slo.py really constructs rules
+    assert any(isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+               and n.func.id == "SLORule" for n in ast.walk(tree))
+    offenders = [f"core/slo.py:{line}: {reason}"
+                 for line, reason in _bad_slo_rule_metrics(tree)]
+    assert not offenders, (
+        "SLO rule metric not declared in core.telemetry."
+        "CANONICAL_METRIC_NAMES (or as a sparkdl.health.<event> mirror "
+        "of a core/health.py constant) — a typo'd metric watches nothing "
+        f"forever. Fix the name or declare the metric: {offenders}")
+
+
+def test_slo_metric_lint_catches_typos_and_resolves_constants():
+    """Self-test: a typo'd literal and a typo'd module constant both
+    trip; canonical literals, module constants and prefix
+    concatenations pass; a local variable is left to the runtime
+    check."""
+    bad = (
+        "from sparkdl_tpu.core import health, telemetry\n"
+        "from sparkdl_tpu.core.slo import SLORule\n"
+        "SLORule('a', metric='sparkdl.executor.queue_wait_ss',\n"  # typo
+        "        window_s=1.0, threshold=1.0)\n"
+        "SLORule('b', metric=telemetry.M_QUEUE_WAIT_S,\n"          # ok
+        "        window_s=1.0, threshold=1.0)\n"
+        "SLORule('c', metric=telemetry.HEALTH_METRIC_PREFIX\n"     # ok
+        "        + health.EXECUTOR_SHED,\n"
+        "        window_s=1.0, threshold=1.0)\n"
+        "SLORule('d', metric=telemetry.HEALTH_METRIC_PREFIX\n"     # typo'd
+        "        + health.EXECUTOR_SHEDD,\n"                       # constant
+        "        window_s=1.0, threshold=1.0)\n"
+        "SLORule('e', metric=some_variable,\n"                     # dynamic
+        "        window_s=1.0, threshold=1.0)\n"
+        "SLORule('f', 'sparkdl.health.not_an_event',\n"            # bad
+        "        1.0, 1.0)\n"                                      # mirror
+    )
+    flagged = _bad_slo_rule_metrics(ast.parse(bad))
+    assert [line for line, _ in flagged] == [3, 10, 15]
+    assert "queue_wait_ss" in flagged[0][1]
+    assert "undeclared module constant" in flagged[1][1]
+    assert "not_an_event" in flagged[2][1]
+    # the shipped default rules resolve through exactly these paths
+    assert "sparkdl.health.executor_shed" not in \
+        _telemetry.CANONICAL_METRIC_NAMES
+    assert "executor_shed" in _HEALTH_EVENT_VALUES
