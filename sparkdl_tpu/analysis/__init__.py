@@ -1,0 +1,30 @@
+"""Static-analysis subsystem: one rule framework, one suppression
+syntax, one catalog (ISSUE 8; docs/ANALYSIS.md).
+
+Importing this package registers the shipped rule packs — the
+concurrency-discipline analyzer (:mod:`.concurrency`) and the six
+migrated taxonomy lints (:mod:`.lints`) — into the framework registry.
+Run it: ``python -m sparkdl_tpu.analysis [--rule ID] [--json]``; gate
+it: ``tests/test_analysis.py`` runs the full catalog over
+``sparkdl_tpu/`` in tier-1.
+"""
+
+from sparkdl_tpu.analysis.framework import (  # noqa: F401 - public API
+    AnalysisResult,
+    Finding,
+    Rule,
+    SourceFile,
+    UnknownRuleError,
+    all_rules,
+    analyze,
+    analyze_sources,
+    collect_sources,
+    register,
+    rule,
+)
+from sparkdl_tpu.analysis import concurrency as _concurrency  # noqa: F401,E501 - registers the concurrency rule pack
+from sparkdl_tpu.analysis import lints as _lints  # noqa: F401 - registers the migrated lints
+from sparkdl_tpu.analysis.baseline import (  # noqa: F401 - public API
+    DEFAULT_BASELINE_PATH,
+    Baseline,
+)

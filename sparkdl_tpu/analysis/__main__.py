@@ -1,0 +1,7 @@
+"""``python -m sparkdl_tpu.analysis`` entry point."""
+
+import sys
+
+from sparkdl_tpu.analysis.cli import main
+
+sys.exit(main())

@@ -1,0 +1,89 @@
+"""Checked-in baseline for grandfathered findings.
+
+A baseline entry is ``{rule, path, message}`` — deliberately
+line-number-free, so unrelated edits shifting a file don't churn the
+baseline. The engine treats a finding matching an entry as
+*baselined* (reported separately, not a failure); entries that no
+longer match anything are *stale* and surfaced so the file shrinks
+monotonically.
+
+Policy (ISSUE 8): the baseline exists for future emergencies — the
+shipped file is EMPTY. A real hazard gets fixed; an intentional
+pattern gets an inline ``# sparkdl: allow(<rule>): <why>`` with its
+justification next to the code. Never silently baseline a real hazard.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import re
+from typing import Any, Dict, Iterable, List, Set, Tuple
+
+from sparkdl_tpu.analysis.framework import Finding
+
+#: The checked-in baseline the CLI and the tier-1 gate read by default.
+DEFAULT_BASELINE_PATH = pathlib.Path(__file__).with_name("baseline.json")
+
+Key = Tuple[str, str, str]
+
+#: Line references embedded in finding MESSAGES ("acquired line 12",
+#: "at path.py:34", "from Cls.m:56") — normalized out of the matching
+#: key, or an unrelated edit shifting the file would churn the baseline
+#: the line-free key exists to prevent.
+_LINE_REF_RE = re.compile(r"\b(line |:)\d+")
+
+
+def _normalize(message: str) -> str:
+    return _LINE_REF_RE.sub(r"\1N", message)
+
+
+class Baseline:
+    """A loaded set of grandfathered findings."""
+
+    def __init__(self, entries: Iterable[Dict[str, Any]] = ()) -> None:
+        self.entries: List[Dict[str, Any]] = [
+            {"rule": e["rule"], "path": e["path"],
+             "message": e["message"]} for e in entries]
+        self._keys: Set[Key] = {self.key_of(e) for e in self.entries}
+
+    @staticmethod
+    def key_of(entry: Dict[str, Any]) -> Key:
+        return (entry["rule"], entry["path"],
+                _normalize(entry["message"]))
+
+    def key(self, finding: Finding) -> Key:
+        return (finding.rule, finding.path,
+                _normalize(finding.message))
+
+    def match(self, finding: Finding) -> bool:
+        return self.key(finding) in self._keys
+
+    def stale(self, matched: Set[Key]) -> List[Dict[str, Any]]:
+        """Entries no fresh finding matched — candidates for deletion."""
+        return [e for e in self.entries
+                if self.key_of(e) not in matched]
+
+    # -- persistence ---------------------------------------------------------
+
+    @classmethod
+    def load(cls, path: pathlib.Path) -> "Baseline":
+        path = pathlib.Path(path)
+        if not path.exists():
+            return cls()
+        data = json.loads(path.read_text() or "{}")
+        return cls(data.get("entries", []))
+
+    @classmethod
+    def from_findings(cls, findings: Iterable[Finding]) -> "Baseline":
+        return cls(f.as_dict() for f in findings)
+
+    def save(self, path: pathlib.Path) -> None:
+        entries = sorted(self.entries,
+                         key=lambda e: (e["path"], e["rule"],
+                                        e["message"]))
+        pathlib.Path(path).write_text(json.dumps(
+            {"comment": "grandfathered analyzer findings — see "
+                        "docs/ANALYSIS.md; keep empty unless an "
+                        "emergency demands otherwise",
+             "entries": entries}, indent=2) + "\n")

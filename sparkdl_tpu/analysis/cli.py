@@ -1,0 +1,109 @@
+"""``python -m sparkdl_tpu.analysis`` — the analyzer CLI.
+
+Exit codes (pinned by tests/test_analysis.py):
+
+- ``0`` — clean (no unsuppressed, unbaselined findings)
+- ``1`` — findings
+- ``2`` — usage error (unknown rule, nonexistent path, bad flags)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+from typing import List, Optional
+
+from sparkdl_tpu.analysis import baseline as baseline_mod
+from sparkdl_tpu.analysis import framework
+
+
+def _parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m sparkdl_tpu.analysis",
+        description="sparkdl_tpu static analyzer: concurrency "
+                    "discipline + the migrated taxonomy lints "
+                    "(docs/ANALYSIS.md)")
+    p.add_argument("paths", nargs="*",
+                   help="files/directories to analyze (default: the "
+                        "sparkdl_tpu package)")
+    p.add_argument("--rule", action="append", dest="rules",
+                   metavar="ID",
+                   help="run only this rule (repeatable; default all)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output (schema version 1)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit 0")
+    p.add_argument("--baseline", metavar="FILE",
+                   default=str(baseline_mod.DEFAULT_BASELINE_PATH),
+                   help="baseline file (default: the checked-in "
+                        "analysis/baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline entirely")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write current findings to --baseline and "
+                        "exit 0 (emergency grandfathering; prefer "
+                        "inline suppressions)")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    try:
+        args = _parser().parse_args(argv)
+    except SystemExit as e:  # argparse exits 2 on usage errors
+        return int(e.code or 0)
+
+    if args.list_rules:
+        for rule_id, rule in sorted(framework.all_rules().items()):
+            print(f"{rule_id:24s} {rule.title}")
+        return 0
+
+    paths = [pathlib.Path(p) for p in args.paths] \
+        or [framework.PACKAGE_ROOT]
+    for p in paths:
+        if not p.exists():
+            print(f"error: no such path: {p}", file=sys.stderr)
+            return 2
+    try:
+        rule_ids = list(args.rules) if args.rules else None
+        # --write-baseline regenerates from the FULL finding set: loading
+        # the existing baseline first would absorb its own entries and
+        # write an empty file on the second run
+        bl = (None if args.no_baseline or args.write_baseline
+              else baseline_mod.Baseline.load(pathlib.Path(args.baseline)))
+        result = framework.analyze(paths, rule_ids=rule_ids, baseline=bl)
+    except framework.UnknownRuleError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        # hygiene/parse-error findings are never baselineable (they are
+        # trivial to fix and grandfathering an unjustified suppression
+        # would defeat the justification requirement) — writing them
+        # would only create instantly-stale entries
+        grandfatherable = [
+            f for f in result.findings
+            if f.rule not in (framework.PARSE_ERROR,
+                              framework.SUPPRESSION_HYGIENE)]
+        baseline_mod.Baseline.from_findings(grandfatherable).save(
+            pathlib.Path(args.baseline))
+        print(f"wrote {len(grandfatherable)} entr"
+              f"{'y' if len(grandfatherable) == 1 else 'ies'} to "
+              f"{args.baseline}")
+        return 0
+
+    if args.json:
+        print(json.dumps(result.as_dict(), indent=2))
+    else:
+        for f in result.findings:
+            print(str(f))
+        for e in result.stale_baseline:
+            print(f"stale baseline entry (no longer matches): "
+                  f"{e['path']}: [{e['rule']}] {e['message']}",
+                  file=sys.stderr)
+        print(f"{len(result.findings)} finding(s) "
+              f"({len(result.suppressed)} suppressed, "
+              f"{len(result.baselined)} baselined) across "
+              f"{result.files} file(s)")
+    return 1 if result.findings else 0

@@ -1,0 +1,318 @@
+"""Concurrency-discipline rules (ISSUE 8 flagship rule pack).
+
+The codebase is genuinely multi-threaded — coalescer threads
+(``core/executor.py``), the snapshot-exporter daemon
+(``core/telemetry.py``), the prefetcher producer (``core/pipeline.py``),
+the supervisor pool (``engine/dataframe.py``) — coordinating through
+~20 locks and conditions. PR 6/7 reviews each caught a locking hazard
+by hand; these rules make that vigilance a tool:
+
+- ``lock-order`` — build the cross-module lock-acquisition-order graph
+  (every ``with A:`` nesting ``with B:``, directly or through
+  same-module calls made while holding ``A``) and fail on cycles —
+  two threads taking the same pair of locks in opposite orders is a
+  deadlock waiting for load — and on re-acquisition of a plain
+  (non-reentrant) ``Lock`` while already held.
+- ``wait-holding-lock`` — ``cond.wait()`` releases only the
+  condition's OWN lock; waiting while holding any other lock parks
+  that lock for the whole wait and deadlocks as soon as the waker
+  needs it.
+- ``blocking-under-lock`` — ``time.sleep``, ``future.result``,
+  thread ``join``, file writes, device fetches (``np.asarray``,
+  ``device_get``, ``block_until_ready``), ``executor.execute``,
+  ``subprocess.run`` under a held lock stall every sibling contending
+  for that lock for the duration — the exact class of bug the PR 6/7
+  reviews caught by hand (the coalescer-thread backoff sleep, the
+  lock-order-unsafe ``status()``).
+- ``unguarded-shared-write`` — in a class that owns a lock, a
+  ``self._x = …`` store outside any lock scope (``__init__`` exempt:
+  construction is single-threaded by convention) is either a data race
+  or an undocumented single-thread contract; the suppression comment
+  is the explicit "intentionally unguarded" escape hatch.
+- ``thread-lifecycle`` — every ``threading.Thread(…)`` must set
+  ``name=`` (anonymous ``Thread-N`` names make every stack dump and
+  telemetry track unreadable) and live in a module with a reachable
+  ``join`` path (a thread nobody can join is a leak by construction).
+
+All static, all conservative: resolution failures drop edges rather
+than inventing them (see :mod:`sparkdl_tpu.analysis.locks` for exactly
+what resolves). Suppress with
+``# sparkdl: allow(<rule>): <justification>`` on the finding's line.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Set, Tuple
+
+from sparkdl_tpu.analysis import locks
+from sparkdl_tpu.analysis.framework import (Finding, Rule, SourceFile,
+                                            register)
+
+
+def _held_desc(held) -> str:
+    return " + ".join(f"{h.lock.qualname} (acquired line {h.line})"
+                      for h in held)
+
+
+@register
+class LockOrderRule(Rule):
+    id = "lock-order"
+    title = "lock-acquisition-order cycles and Lock re-acquisition"
+    rationale = (
+        "Two code paths taking the same pair of locks in opposite "
+        "orders deadlock under load; re-acquiring a plain "
+        "threading.Lock already held by this thread deadlocks "
+        "immediately. The rule merges every module's nested-with and "
+        "held-call acquisition edges into one graph and rejects "
+        "cycles.")
+
+    def finalize(self, sources: Sequence[SourceFile]) -> List[Finding]:
+        # edge (A, B) -> first observed site (rel, line, via)
+        edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+        kinds: Dict[str, str] = {}
+
+        def add(a: locks.Lock, b: locks.Lock, rel: str, line: int,
+                via: str) -> None:
+            kinds[a.qualname] = a.kind
+            kinds[b.qualname] = b.kind
+            edges.setdefault((a.qualname, b.qualname), (rel, line, via))
+
+        for src in sources:
+            model = locks.module_model(src)
+            reach = locks.reachable_acquired(model)
+            for key, s in model.all_summaries():
+                for a, b, line in s.edges:
+                    add(a, b, src.rel, line, s.qualname)
+                for callee, line, held in s.calls:
+                    if not held:
+                        continue
+                    for item in reach.get(callee, ()):
+                        lk, _lline, via = item
+                        for h in held:
+                            add(h.lock, lk, src.rel, line,
+                                f"{s.qualname} -> {via}")
+
+        findings: List[Finding] = []
+        # self-edges: re-acquiring a non-reentrant Lock while held
+        for (a, b), (rel, line, via) in sorted(edges.items()):
+            if a == b and kinds.get(a) == "lock":
+                findings.append(self.finding(
+                    rel, line,
+                    f"{a} is a plain (non-reentrant) threading.Lock "
+                    f"re-acquired while already held (in {via}) — this "
+                    "deadlocks immediately"))
+        # cycles among distinct locks
+        adj: Dict[str, Set[str]] = {}
+        for (a, b) in edges:
+            if a != b:
+                adj.setdefault(a, set()).add(b)
+                adj.setdefault(b, set())
+        for comp in _sccs(sorted(adj), adj):
+            if len(comp) < 2:
+                continue
+            comp_set = set(comp)
+            sites = sorted(
+                f"{a} -> {b} at {rel}:{line} (in {via})"
+                for (a, b), (rel, line, via) in edges.items()
+                if a in comp_set and b in comp_set and a != b)
+            anchor = min((edges[(a, b)], (a, b))
+                         for (a, b) in edges
+                         if a in comp_set and b in comp_set and a != b)[0]
+            findings.append(self.finding(
+                anchor[0], anchor[1],
+                "lock-acquisition-order cycle (potential deadlock) "
+                f"among {sorted(comp_set)}: " + "; ".join(sites)))
+        return findings
+
+
+def _sccs(nodes: Sequence[str],
+          adj: Dict[str, Set[str]]) -> List[List[str]]:
+    """Tarjan strongly-connected components (iterative-friendly sizes:
+    the lock graph is tiny)."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    stack: List[str] = []
+    on: Set[str] = set()
+    out: List[List[str]] = []
+    counter = [0]
+
+    def strong(v: str) -> None:
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on.add(v)
+        for w in sorted(adj.get(v, ())):
+            if w not in index:
+                strong(w)
+                low[v] = min(low[v], low[w])
+            elif w in on:
+                low[v] = min(low[v], index[w])
+        if low[v] == index[v]:
+            comp = []
+            while True:
+                w = stack.pop()
+                on.discard(w)
+                comp.append(w)
+                if w == v:
+                    break
+            out.append(comp)
+
+    for v in nodes:
+        if v not in index:
+            strong(v)
+    return out
+
+
+@register
+class WaitHoldingLockRule(Rule):
+    id = "wait-holding-lock"
+    title = "cond.wait() while holding a different lock"
+    rationale = (
+        "Condition.wait releases only the condition's own lock; any "
+        "OTHER lock held across the wait stays held for the whole "
+        "sleep and deadlocks the moment the intended waker needs it.")
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        model = locks.module_model(src)
+        reach = locks.reachable_waits(model)
+        findings: List[Finding] = []
+        seen: Set[Tuple[int, str, str]] = set()
+
+        def flag(cond: locks.Lock, line: int, held, via: str = "") -> None:
+            foreign = [h for h in held
+                       if h.lock.qualname != cond.qualname]
+            if not foreign:
+                return
+            key = (line, cond.qualname,
+                   foreign[0].lock.qualname)
+            if key in seen:
+                return
+            seen.add(key)
+            findings.append(self.finding(
+                src, line,
+                f"{cond.qualname}.wait() while holding "
+                f"{_held_desc(foreign)}"
+                + (f" (reached {via})" if via else "")
+                + " — wait releases only the condition's own lock; the "
+                "foreign lock stays held for the whole sleep"))
+
+        for _key, s in model.all_summaries():
+            for cond, line, held in s.waits:
+                flag(cond, line, held)
+            for callee, cline, held in s.calls:
+                if not held:
+                    continue
+                for cond, wline, via in reach.get(callee, ()):
+                    flag(cond, wline, held,
+                         via=f"from {s.qualname}:{cline} via {via}")
+        return findings
+
+
+@register
+class BlockingUnderLockRule(Rule):
+    id = "blocking-under-lock"
+    title = "blocking call under a held lock"
+    rationale = (
+        "time.sleep / future.result / thread join / file writes / "
+        "device fetches (np.asarray, device_get, block_until_ready) / "
+        "executor.execute / subprocess under a held lock stall every "
+        "thread contending for that lock for the whole duration — the "
+        "coalescer, exporter and supervisor threads all share locks "
+        "with hot paths. Move the blocking call outside the lock "
+        "scope, or suppress with the documented single-writer "
+        "justification.")
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        model = locks.module_model(src)
+        reach = locks.reachable_blocking(model)
+        findings: List[Finding] = []
+        seen: Set[Tuple[int, str, str]] = set()
+
+        def flag(desc: str, line: int, held, via: str = "") -> None:
+            if not held:
+                return
+            key = (line, desc, held[0].lock.qualname)
+            if key in seen:
+                return
+            seen.add(key)
+            findings.append(self.finding(
+                src, line,
+                f"blocking call {desc} while holding "
+                f"{_held_desc(held)}"
+                + (f" (reached {via})" if via else "")))
+
+        for _key, s in model.all_summaries():
+            for desc, line, held in s.blocking:
+                flag(desc, line, held)
+            for callee, cline, held in s.calls:
+                if not held:
+                    continue
+                for desc, bline, via in reach.get(callee, ()):
+                    flag(desc, bline, held,
+                         via=f"from {s.qualname}:{cline} via {via}")
+        return findings
+
+
+@register
+class UnguardedSharedWriteRule(Rule):
+    id = "unguarded-shared-write"
+    title = "self._* store outside any lock scope in a lock-owning class"
+    rationale = (
+        "A class that owns a lock has declared its state shared; a "
+        "``self._x = …`` store outside every lock scope (outside "
+        "__init__) is either a data race or an undocumented "
+        "single-thread contract. Guard it, or make the contract "
+        "explicit with a suppression justification.")
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        model = locks.module_model(src)
+        findings: List[Finding] = []
+        for cls in model.classes.values():
+            if not cls.guard_locks:
+                continue
+            for mname, s in cls.methods.items():
+                if mname == "__init__":
+                    continue  # construction is single-threaded
+                for attr, line, held in s.attr_writes:
+                    if held or not attr.startswith("_") \
+                            or attr.startswith("__") \
+                            or attr in cls.lock_attrs:
+                        continue
+                    findings.append(self.finding(
+                        src, line,
+                        f"{cls.name}.{mname} writes self.{attr} "
+                        f"outside any lock scope, but {cls.name} owns "
+                        f"{', '.join(lk.qualname for lk in cls.guard_locks)}"
+                        " — guard the store or justify the "
+                        "single-writer contract with a suppression"))
+        return findings
+
+
+@register
+class ThreadLifecycleRule(Rule):
+    id = "thread-lifecycle"
+    title = "threads must be named and joinable"
+    rationale = (
+        "An anonymous Thread-N makes every stack dump, log line and "
+        "telemetry track unreadable; a thread created in a module with "
+        "no join path anywhere is a leak by construction (the "
+        "prefetcher, coalescer and exporter all pair creation with a "
+        "close()/shutdown() join).")
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        model = locks.module_model(src)
+        findings: List[Finding] = []
+        for line, has_name in model.threads:
+            if not has_name:
+                findings.append(self.finding(
+                    src, line,
+                    "threading.Thread(...) without name= — name the "
+                    "thread (sparkdl-<role>) so stack dumps and "
+                    "telemetry tracks stay readable"))
+            if not model.has_join:
+                findings.append(self.finding(
+                    src, line,
+                    "threading.Thread(...) in a module with no "
+                    ".join(...) call anywhere — every started thread "
+                    "needs a reachable join/stop path"))
+        return findings

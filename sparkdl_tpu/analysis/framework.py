@@ -1,0 +1,420 @@
+"""Shared static-analysis framework (ISSUE 8 tentpole).
+
+PR 6 and PR 7 review rounds each caught a hand-found locking hazard
+(the lock-order-safe ``status()``, the half-open probe-slot wedge), and
+the repo had grown six one-off AST lints spread through
+``tests/test_taxonomy_lint.py`` — each with its own suppression
+convention and its own walk of the tree. This package is the shared
+engine they all run on, the same move the reference project made when
+it leaned on Spark's analyzer-checked execution plans instead of
+reviewer vigilance (PAPER.md §0): one rule registry, one
+:class:`Finding` shape, one suppression syntax, one baseline format,
+one CLI.
+
+The pieces:
+
+- :class:`Finding` — ``(rule, path, line, message)``; everything a rule
+  reports, everything the CLI prints, everything a baseline stores.
+- :class:`Rule` — the base every check subclasses. ``check(src)`` runs
+  per file; ``finalize(sources)`` runs once with every parsed file for
+  whole-program rules (the lock-order graph). Rules register into a
+  process-wide catalog via :func:`register`.
+- :class:`SourceFile` — path + source + lazily-parsed AST + the parsed
+  suppression directives, shared by every rule (one parse per file per
+  run).
+- **Suppressions** — ``# sparkdl: allow(<rule>): <justification>`` on
+  the finding's line. The justification is part of the grammar: a bare
+  ``allow(<rule>)`` does not suppress (and is itself flagged by the
+  built-in ``suppression-hygiene`` check), so every grandfathered
+  hazard in the tree carries its reason next to it.
+- :func:`analyze` / :func:`analyze_sources` — the engine: run rules,
+  apply suppressions, apply the baseline, return an
+  :class:`AnalysisResult`.
+
+The CLI lives in :mod:`sparkdl_tpu.analysis.cli`
+(``python -m sparkdl_tpu.analysis``); the rule packs in
+:mod:`sparkdl_tpu.analysis.concurrency` (the flagship
+concurrency-discipline analyzer) and :mod:`sparkdl_tpu.analysis.lints`
+(the six migrated one-off lints). Human-readable catalog:
+docs/ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import pathlib
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: The package this analyzer ships with (the default scan target).
+PACKAGE_ROOT = pathlib.Path(__file__).resolve().parent.parent
+REPO_ROOT = PACKAGE_ROOT.parent
+
+#: The ONE suppression syntax (matched against COMMENT tokens only, so
+#: docstrings and string literals describing the syntax never parse as
+#: directives). The justification is required for the directive to
+#: suppress anything (enforced by ``suppression-hygiene``).
+SUPPRESS_RE = re.compile(
+    r"^#\s*sparkdl:\s*allow\(\s*([A-Za-z0-9_\-\s,]+?)\s*\)"
+    r"(?:\s*:\s*(?P<why>\S.*?))?\s*$")
+#: Any comment STARTING with a ``sparkdl:`` directive (typo'd
+#: directives are flagged, never silently ignored; a prose comment
+#: merely mentioning the syntax mid-sentence is not a directive).
+DIRECTIVE_RE = re.compile(r"^#[:!]?\s*sparkdl\s*:")
+
+#: Rule ids reserved by the engine itself (not subclassable):
+#: ``parse-error`` for unparseable files, ``suppression-hygiene`` for
+#: malformed/unjustified/unknown-rule suppression directives.
+PARSE_ERROR = "parse-error"
+SUPPRESSION_HYGIENE = "suppression-hygiene"
+
+
+@dataclass(frozen=True, order=True)
+class Finding:
+    """One analyzer hit: which rule, where, and why it matters."""
+
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message}
+
+
+@dataclass(frozen=True)
+class Suppression:
+    """One parsed ``# sparkdl: allow(...)`` directive.
+
+    ``line`` is where the comment sits; ``target`` is the line it
+    suppresses — the same line for a trailing comment, the NEXT line
+    for a comment-only line (so multi-line statements stay
+    suppressible without 120-column trailers).
+    """
+
+    line: int
+    target: int
+    rules: Tuple[str, ...]
+    justification: Optional[str]
+
+    def covers(self, rule: str) -> bool:
+        """True when this directive suppresses ``rule`` findings on its
+        target line — which requires BOTH the rule name and a
+        justification."""
+        return self.justification is not None and rule in self.rules
+
+
+class SourceFile:
+    """One file under analysis: source, lazily-parsed AST, suppressions.
+
+    ``rel`` is the stable display/baseline path (repo-relative when the
+    file lives under the repo, the given string otherwise). ``cache``
+    is scratch space for cross-rule shared computations (the lock-model
+    extraction memoizes here so three concurrency rules pay one walk).
+    """
+
+    def __init__(self, source: str, rel: str,
+                 path: Optional[pathlib.Path] = None) -> None:
+        self.source = source
+        self.rel = rel
+        self.path = path
+        self.lines = source.splitlines()
+        self.cache: Dict[str, Any] = {}
+        self._tree: Optional[ast.AST] = None
+
+    @classmethod
+    def from_path(cls, path: pathlib.Path,
+                  root: Optional[pathlib.Path] = None) -> "SourceFile":
+        path = pathlib.Path(path).resolve()
+        base = root if root is not None else REPO_ROOT
+        try:
+            rel = str(path.relative_to(base))
+        except ValueError:
+            rel = str(path)
+        return cls(path.read_text(), rel, path=path)
+
+    @classmethod
+    def from_source(cls, source: str,
+                    rel: str = "<memory>.py") -> "SourceFile":
+        return cls(source, rel)
+
+    @property
+    def tree(self) -> ast.AST:
+        """The parsed AST (raises ``SyntaxError``; the engine converts
+        that into a ``parse-error`` finding)."""
+        if self._tree is None:
+            self._tree = ast.parse(self.source, filename=self.rel)
+        return self._tree
+
+    def comments(self) -> Dict[int, str]:
+        """lineno → comment text (COMMENT tokens only — docstrings and
+        string literals are never directives)."""
+        out = self.cache.get("comments")
+        if out is None:
+            out = {}
+            try:
+                for tok in tokenize.generate_tokens(
+                        io.StringIO(self.source).readline):
+                    if tok.type == tokenize.COMMENT:
+                        out[tok.start[0]] = tok.string
+            except (tokenize.TokenError, IndentationError, SyntaxError):
+                pass  # unparseable files already get a parse-error
+            self.cache["comments"] = out
+        return out
+
+    def suppressions(self) -> List[Suppression]:
+        out = self.cache.get("suppressions")
+        if out is None:
+            out = []
+            for lineno, comment in sorted(self.comments().items()):
+                m = SUPPRESS_RE.match(comment)
+                if m is None:
+                    continue
+                rules = tuple(r.strip() for r in m.group(1).split(",")
+                              if r.strip())
+                src_line = (self.lines[lineno - 1]
+                            if lineno <= len(self.lines) else "")
+                target = lineno
+                if src_line.lstrip().startswith("#"):
+                    # comment-only directive: target the next CODE line,
+                    # skipping further comment-only and blank lines so
+                    # stacked directives (and ordinary spacing) all land
+                    # on the same statement
+                    target = lineno + 1
+                    while target <= len(self.lines):
+                        stripped = self.lines[target - 1].strip()
+                        if stripped and not stripped.startswith("#"):
+                            break
+                        target += 1
+                out.append(Suppression(lineno, target, rules,
+                                       m.group("why")))
+            self.cache["suppressions"] = out
+        return out
+
+    def allowed(self, line: int, rule: str) -> Optional[Suppression]:
+        """The justified suppression covering ``rule`` at ``line``,
+        or None."""
+        for sup in self.suppressions():
+            if sup.target == line and sup.covers(rule):
+                return sup
+        return None
+
+
+class Rule:
+    """Base class for one registered check.
+
+    Subclasses set ``id`` (kebab-case, the suppression/CLI handle),
+    ``title`` (one line), ``rationale`` (why the rule exists — shown by
+    ``--list-rules`` and mirrored in docs/ANALYSIS.md), and implement
+    ``check`` (per file) and/or ``finalize`` (once, with every parsed
+    file — for whole-program rules like the lock-order graph).
+    """
+
+    id: str = ""
+    title: str = ""
+    rationale: str = ""
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        return []
+
+    def finalize(self, sources: Sequence[SourceFile]) -> List[Finding]:
+        return []
+
+    def finding(self, src_or_path: Any, line: int,
+                message: str) -> Finding:
+        rel = (src_or_path.rel if isinstance(src_or_path, SourceFile)
+               else str(src_or_path))
+        return Finding(rel, line, self.id, message)
+
+
+class UnknownRuleError(ValueError):
+    """A ``--rule``/``rule_ids`` name that is not in the registry."""
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register(cls: type) -> type:
+    """Class decorator: instantiate and register a :class:`Rule`."""
+    instance = cls()
+    if not instance.id:
+        raise ValueError(f"rule {cls.__name__} has no id")
+    if instance.id in (PARSE_ERROR, SUPPRESSION_HYGIENE):
+        raise ValueError(f"rule id {instance.id!r} is reserved")
+    if instance.id in _REGISTRY:
+        raise ValueError(f"duplicate rule id {instance.id!r}")
+    _REGISTRY[instance.id] = instance
+    return cls
+
+
+def all_rules() -> Dict[str, Rule]:
+    """The registered rule catalog (importing the package registers the
+    shipped packs)."""
+    return dict(_REGISTRY)
+
+
+def rule(rule_id: str) -> Rule:
+    try:
+        return _REGISTRY[rule_id]
+    except KeyError:
+        raise UnknownRuleError(
+            f"unknown rule {rule_id!r}; registered: "
+            f"{sorted(_REGISTRY)}") from None
+
+
+@dataclass
+class AnalysisResult:
+    """One analyzer run: what fired, what was suppressed, what the
+    baseline absorbed."""
+
+    findings: List[Finding] = field(default_factory=list)
+    suppressed: List[Tuple[Finding, str]] = field(default_factory=list)
+    baselined: List[Finding] = field(default_factory=list)
+    stale_baseline: List[Dict[str, Any]] = field(default_factory=list)
+    files: int = 0
+    rule_ids: List[str] = field(default_factory=list)
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+    def as_dict(self) -> Dict[str, Any]:
+        """The ``--json`` schema (tests/test_analysis.py pins it)."""
+        return {
+            "version": 1,
+            "rules": list(self.rule_ids),
+            "files": self.files,
+            "findings": [f.as_dict() for f in self.findings],
+            "suppressed": [
+                {**f.as_dict(), "justification": why}
+                for f, why in self.suppressed],
+            "counts": {
+                "findings": len(self.findings),
+                "suppressed": len(self.suppressed),
+                "baselined": len(self.baselined),
+            },
+            "stale_baseline": list(self.stale_baseline),
+        }
+
+
+def collect_sources(paths: Iterable[Any]) -> List[SourceFile]:
+    """Every ``.py`` file under ``paths`` (files or directories),
+    sorted, parsed lazily."""
+    files: List[pathlib.Path] = []
+    for p in paths:
+        p = pathlib.Path(p)
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        else:
+            files.append(p)
+    return [SourceFile.from_path(p) for p in files]
+
+
+def _hygiene_findings(src: SourceFile,
+                      known: Iterable[str]) -> List[Finding]:
+    """Malformed / unjustified / unknown-rule suppression directives.
+    These findings are not themselves suppressible — they are trivial
+    to fix and exist to keep every suppression in the tree justified."""
+    known = set(known)
+    out: List[Finding] = []
+    seen_lines = set()
+    for sup in src.suppressions():
+        seen_lines.add(sup.line)
+        if sup.justification is None:
+            out.append(Finding(
+                src.rel, sup.line, SUPPRESSION_HYGIENE,
+                f"suppression for {', '.join(sup.rules)} has no "
+                "justification — write "
+                "'# sparkdl: allow(<rule>): <why this is safe>'"))
+        for r in sup.rules:
+            if r not in known:
+                out.append(Finding(
+                    src.rel, sup.line, SUPPRESSION_HYGIENE,
+                    f"suppression names unknown rule {r!r} (see "
+                    "--list-rules); it suppresses nothing"))
+    for lineno, comment in sorted(src.comments().items()):
+        if lineno in seen_lines:
+            continue
+        if DIRECTIVE_RE.match(comment) \
+                and SUPPRESS_RE.match(comment) is None:
+            out.append(Finding(
+                src.rel, lineno, SUPPRESSION_HYGIENE,
+                "unrecognized '# sparkdl:' directive — the only "
+                "supported form is '# sparkdl: allow(<rule>): <why>'"))
+    return out
+
+
+def analyze_sources(sources: Sequence[SourceFile],
+                    rule_ids: Optional[Sequence[str]] = None,
+                    baseline: Any = None) -> AnalysisResult:
+    """Run the analyzer over already-built sources (the engine under
+    :func:`analyze`; self-tests seed violations through here)."""
+    if rule_ids is None:
+        rules = list(_REGISTRY.values())
+        run_hygiene = True
+    else:
+        rules = [rule(r) for r in rule_ids if r != SUPPRESSION_HYGIENE]
+        run_hygiene = SUPPRESSION_HYGIENE in rule_ids
+    raw: List[Finding] = []
+    parsed: List[SourceFile] = []
+    by_rel: Dict[str, SourceFile] = {}
+    for src in sources:
+        by_rel[src.rel] = src
+        try:
+            src.tree
+        except SyntaxError as e:
+            raw.append(Finding(src.rel, e.lineno or 1, PARSE_ERROR,
+                               f"file does not parse: {e.msg}"))
+            continue
+        parsed.append(src)
+        for r in rules:
+            raw.extend(r.check(src))
+        if run_hygiene:
+            raw.extend(_hygiene_findings(src, _REGISTRY))
+    for r in rules:
+        raw.extend(r.finalize(parsed))
+
+    result = AnalysisResult(files=len(sources),
+                            rule_ids=[r.id for r in rules]
+                            + ([SUPPRESSION_HYGIENE] if run_hygiene
+                               else []))
+    matched_baseline = set()
+    for f in sorted(set(raw)):
+        src = by_rel.get(f.path)
+        if f.rule in (PARSE_ERROR, SUPPRESSION_HYGIENE):
+            # neither suppressible nor baselineable: both are trivial
+            # to fix, and grandfathering an unjustified suppression
+            # would defeat the justification requirement entirely
+            result.findings.append(f)
+            continue
+        if src is not None:
+            sup = src.allowed(f.line, f.rule)
+            if sup is not None:
+                result.suppressed.append((f, sup.justification or ""))
+                continue
+        if baseline is not None and baseline.match(f):
+            matched_baseline.add(baseline.key(f))
+            result.baselined.append(f)
+            continue
+        result.findings.append(f)
+    if baseline is not None:
+        result.stale_baseline = baseline.stale(matched_baseline)
+    return result
+
+
+def analyze(paths: Optional[Iterable[Any]] = None,
+            rule_ids: Optional[Sequence[str]] = None,
+            baseline: Any = None) -> AnalysisResult:
+    """Analyze ``paths`` (default: the ``sparkdl_tpu`` package) with the
+    selected rules (default: all registered)."""
+    if paths is None:
+        paths = [PACKAGE_ROOT]
+    return analyze_sources(collect_sources(paths), rule_ids=rule_ids,
+                           baseline=baseline)

@@ -1,0 +1,466 @@
+"""The six one-off AST lints, migrated onto the shared framework.
+
+These grew one per PR in ``tests/test_taxonomy_lint.py`` (ISSUEs 2–7),
+each with its own tree walk and its own suppression spelling. Here they
+are registered rules — one engine, one suppression syntax
+(``# sparkdl: allow(<rule>): <why>``), one catalog (docs/ANALYSIS.md) —
+and the test module shrinks to thin wrappers that invoke the analyzer.
+
+- ``broad-retry`` — no blind broad-except retry loops bypassing
+  ``core.resilience.classify`` (ISSUE 2).
+- ``blocking-fetch-in-fit`` — no blocking device fetch inside
+  ``Trainer.fit``'s step loop (ISSUE 3).
+- ``span-names`` — every ``annotate()``/``span()`` name must be in
+  ``core.telemetry.CANONICAL_SPAN_NAMES`` (ISSUE 4).
+- ``executor-choke-point`` — the featurize route (ml/udf/engine/image)
+  enters the device only via ``executor.execute`` (ISSUE 5).
+- ``health-constants`` — every ``health.record(...)`` passes a
+  ``health.<CONSTANT>`` declared in ``core/health.py`` (ISSUE 6).
+- ``slo-metrics`` — every ``SLORule(metric=…)`` statically resolves to
+  a declared metric (ISSUE 7).
+
+Constant resolution goes through the LIVE ``core`` modules (telemetry /
+profiling / health import nothing heavy), exactly as the original lints
+did — a catalog addition is picked up without touching the analyzer.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+from typing import List, Optional, Tuple
+
+from sparkdl_tpu.analysis.framework import (Finding, Rule, SourceFile,
+                                            register)
+from sparkdl_tpu.core import health as _health
+from sparkdl_tpu.core import profiling as _profiling
+from sparkdl_tpu.core import telemetry as _telemetry
+
+# ---------------------------------------------------------------------------
+# broad-retry (ISSUE 2)
+# ---------------------------------------------------------------------------
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    if isinstance(t, ast.Name):
+        return t.id in _BROAD
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(e, ast.Name) and e.id in _BROAD
+                   for e in t.elts)
+    return False
+
+
+def _consults_taxonomy_or_raises(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Name) and node.id in ("classify",
+                                                      "resilience"):
+            return True
+        if isinstance(node, ast.Attribute) and node.attr == "classify":
+            return True
+    return False
+
+
+@register
+class BroadRetryRule(Rule):
+    id = "broad-retry"
+    title = "broad except inside a loop without classify/re-raise"
+    rationale = (
+        "Inside a for/while loop, an `except:`/`except Exception` "
+        "handler that neither re-raises nor consults "
+        "core.resilience.classify is the blind-retry shape PR 1/2 "
+        "removed — FATAL user errors would be silently replayed. "
+        "Deliberate non-retry swallows carry a suppression "
+        "justification instead.")
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        findings: List[Finding] = []
+        loop_depth = 0
+
+        def visit(node: ast.AST) -> None:
+            nonlocal loop_depth
+            is_loop = isinstance(node, (ast.For, ast.While,
+                                        ast.AsyncFor))
+            if is_loop:
+                loop_depth += 1
+            if isinstance(node, (ast.Try, getattr(ast, "TryStar",
+                                                  ast.Try))):
+                for handler in node.handlers:
+                    if (loop_depth > 0 and _is_broad(handler)
+                            and not _consults_taxonomy_or_raises(
+                                handler)):
+                        findings.append(self.finding(
+                            src, handler.lineno,
+                            "broad except inside a loop without "
+                            "re-raise or core.resilience.classify — "
+                            "blind retry would replay FATAL errors"))
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+            if is_loop:
+                loop_depth -= 1
+
+        visit(src.tree)
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# blocking-fetch-in-fit (ISSUE 3)
+# ---------------------------------------------------------------------------
+
+_FETCH_NAMES = {"int", "float"}
+_FETCH_ATTRS = {"asarray", "device_get", "block_until_ready"}
+
+
+def blocking_fetches_in_fit(tree: ast.AST) -> List[int]:
+    """Lines of blocking-fetch calls inside ``Trainer.fit``'s own loops
+    (empty when the tree has no ``Trainer.fit``). Nested function
+    DEFINITIONS are exempt — only their call sites block."""
+    fit = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "Trainer":
+            for item in node.body:
+                if (isinstance(item, ast.FunctionDef)
+                        and item.name == "fit"):
+                    fit = item
+    if fit is None:
+        return []
+
+    loops: List[ast.AST] = []
+
+    def find_loops(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue  # helper closures run at sync points, not here
+            if isinstance(child, (ast.For, ast.While, ast.AsyncFor)):
+                loops.append(child)
+            find_loops(child)
+
+    find_loops(fit)
+
+    def walk_pruned(node: ast.AST):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            yield child
+            yield from walk_pruned(child)
+
+    violations = []
+    for loop in loops:
+        for node in walk_pruned(loop):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Name) and f.id in _FETCH_NAMES:
+                violations.append(node.lineno)
+            elif isinstance(f, ast.Attribute) and f.attr in _FETCH_ATTRS:
+                violations.append(node.lineno)
+    return sorted(set(violations))
+
+
+@register
+class BlockingFetchInFitRule(Rule):
+    id = "blocking-fetch-in-fit"
+    title = "blocking device fetch inside Trainer.fit's step loop"
+    rationale = (
+        "int()/float() on a device scalar, np.asarray, jax.device_get "
+        "or block_until_ready inside the fit step loop re-serializes "
+        "host staging with device compute — the exact regression the "
+        "DevicePrefetcher removed. Fetches belong in the designated "
+        "sync helpers, called only at sync points.")
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        return [self.finding(
+            src, line,
+            "blocking device fetch inside Trainer.fit's step loop — "
+            "move it into the sync helpers (sync/save_checkpoint) "
+            "called only at sync points")
+            for line in blocking_fetches_in_fit(src.tree)]
+
+
+# ---------------------------------------------------------------------------
+# span-names (ISSUE 4)
+# ---------------------------------------------------------------------------
+
+_SPAN_CALL_NAMES = {"annotate", "span"}
+
+
+def _resolve_span_name(arg: ast.expr) -> Optional[str]:
+    """String value of a span-name argument, or None when dynamic."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    attr = None
+    if isinstance(arg, ast.Attribute):   # profiling.STAGE_BATCH
+        attr = arg.attr
+    elif isinstance(arg, ast.Name):      # SPAN_RUN inside telemetry.py
+        attr = arg.id
+    if attr is not None:
+        for mod in (_profiling, _telemetry):
+            value = getattr(mod, attr, None)
+            if isinstance(value, str):
+                return value
+    return None
+
+
+def span_names_in(tree: ast.AST) -> List[Tuple[str, int]]:
+    """(name, lineno) for every statically-resolvable
+    ``annotate()``/``span()`` call."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        f = node.func
+        fname = (f.id if isinstance(f, ast.Name)
+                 else f.attr if isinstance(f, ast.Attribute) else None)
+        if fname not in _SPAN_CALL_NAMES:
+            continue
+        name = _resolve_span_name(node.args[0])
+        if name is not None:
+            out.append((name, node.lineno))
+    return out
+
+
+@register
+class SpanNamesRule(Rule):
+    id = "span-names"
+    title = "annotate()/span() names must be canonical"
+    rationale = (
+        "A typo'd phase name silently forks a timer and a trace track "
+        "instead of failing. Every literal or module-constant name "
+        "must be declared in core.telemetry.CANONICAL_SPAN_NAMES "
+        "(docs/OBSERVABILITY.md is the human catalog); dynamic names "
+        "are not checkable and are skipped.")
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        catalog = _telemetry.CANONICAL_SPAN_NAMES
+        return [self.finding(
+            src, line,
+            f"span/phase name {name!r} is not declared in "
+            "core.telemetry.CANONICAL_SPAN_NAMES — add it to the "
+            "catalog (and docs/OBSERVABILITY.md) or fix the typo")
+            for name, line in span_names_in(src.tree)
+            if name not in catalog]
+
+
+# ---------------------------------------------------------------------------
+# executor-choke-point (ISSUE 5)
+# ---------------------------------------------------------------------------
+
+_DEVICE_ENTRY_ATTRS = {"apply_batch", "jitted"}
+#: The featurize/serving route that MUST go through the executor. The
+#: choke point itself (core/executor.py) and the model layer it wraps
+#: (core/model_function.py) live outside these scopes by design; the
+#: training path (train/) owns its own step programs and is exempt.
+CHOKE_SCOPES = ("ml", "udf", "engine", "image")
+
+
+def direct_device_entry_calls(tree: ast.AST) -> List[int]:
+    """Lines of direct ``.apply_batch(...)`` / ``.jitted(...)`` calls."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in _DEVICE_ENTRY_ATTRS:
+            out.append(node.lineno)
+    return sorted(out)
+
+
+@register
+class ExecutorChokePointRule(Rule):
+    id = "executor-choke-point"
+    title = "featurize route must enter the device via executor.execute"
+    rationale = (
+        "A transformer/UDF/engine op calling apply_batch or jitted "
+        "directly silently regresses the featurize route to "
+        "per-partition launches (docs/PERF.md 'Cross-partition "
+        "coalescing'), invisible until the next bench round. Only the "
+        "executor choke point and the model layer it wraps may touch "
+        "those methods.")
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        parts = set(pathlib.PurePath(src.rel).parts)
+        if not parts & set(CHOKE_SCOPES):
+            return []
+        return [self.finding(
+            src, line,
+            "direct apply_batch/jitted call on the engine featurize "
+            "route — device entry must go through "
+            "core.executor.execute (the coalescing choke point)")
+            for line in direct_device_entry_calls(src.tree)]
+
+
+# ---------------------------------------------------------------------------
+# health-constants (ISSUE 6)
+# ---------------------------------------------------------------------------
+
+#: Event-name constants declared in core/health.py: UPPERCASE module
+#: attributes holding strings.
+HEALTH_EVENT_CONSTANTS = frozenset(
+    name for name in vars(_health)
+    if name.isupper() and isinstance(getattr(_health, name), str))
+
+
+def bad_health_record_calls(tree: ast.AST) -> List[Tuple[int, str]]:
+    """(lineno, reason) for every ``health.record(...)`` call whose
+    event argument is not a declared ``health.<CONSTANT>``."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        # the framework-wide convention: `health.record(...)` on the
+        # imported module object (never `from ... import record`)
+        if not (isinstance(f, ast.Attribute) and f.attr == "record"
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "health"):
+            continue
+        if not node.args:
+            out.append((node.lineno, "no event argument"))
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            out.append((node.lineno, f"bare string {arg.value!r}"))
+            continue
+        if not (isinstance(arg, ast.Attribute)
+                and isinstance(arg.value, ast.Name)
+                and arg.value.id == "health"):
+            out.append((node.lineno,
+                        "event name is not a health.<CONSTANT> "
+                        "reference"))
+            continue
+        if arg.attr not in HEALTH_EVENT_CONSTANTS:
+            out.append((node.lineno,
+                        f"health.{arg.attr} is not declared in "
+                        "core/health.py"))
+    return out
+
+
+@register
+class HealthConstantsRule(Rule):
+    id = "health-constants"
+    title = "health.record() must pass a declared health.<CONSTANT>"
+    rationale = (
+        "A bare-string or typo'd event name silently forks a counter "
+        "outside the docs catalog, the chaos accounting and the "
+        "sparkdl.health.* telemetry mirrors. Declare the event in "
+        "core/health.py and reference the constant.")
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        return [self.finding(
+            src, line,
+            f"health.record() event argument: {reason} — declare the "
+            "event in core/health.py and reference it as "
+            "health.<CONSTANT>")
+            for line, reason in bad_health_record_calls(src.tree)]
+
+
+# ---------------------------------------------------------------------------
+# slo-metrics (ISSUE 7)
+# ---------------------------------------------------------------------------
+
+#: Declared health-event VALUES (the strings the mirrors are named
+#: after).
+_HEALTH_EVENT_VALUES = frozenset(
+    getattr(_health, name) for name in HEALTH_EVENT_CONSTANTS)
+
+_SLO_CONST_MODULES = ("telemetry", "health", "profiling", "slo")
+_UNRESOLVED = object()
+
+
+def _resolve_string_expr(node: ast.expr):
+    """Static string value: literals, telemetry./health./profiling.
+    module constants (bare names resolve too, for constants referenced
+    inside their own module), and ``+`` concatenations of those.
+    ``_UNRESOLVED`` for a module-constant reference that does not exist
+    (a typo'd constant); None when genuinely dynamic."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    attr = None
+    flag_missing = False
+    if isinstance(node, ast.Attribute):
+        attr = node.attr
+        flag_missing = (isinstance(node.value, ast.Name)
+                        and node.value.id in _SLO_CONST_MODULES)
+    elif isinstance(node, ast.Name):
+        attr = node.id
+    if attr is not None:
+        for mod in (_telemetry, _health, _profiling):
+            value = getattr(mod, attr, None)
+            if isinstance(value, str):
+                return value
+        return _UNRESOLVED if flag_missing else None
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        left = _resolve_string_expr(node.left)
+        right = _resolve_string_expr(node.right)
+        if left is _UNRESOLVED or right is _UNRESOLVED:
+            return _UNRESOLVED
+        if left is not None and right is not None:
+            return left + right
+    return None
+
+
+def bad_slo_rule_metrics(tree: ast.AST) -> List[Tuple[int, str]]:
+    """(lineno, reason) for every ``SLORule(...)`` whose metric does
+    not statically resolve to a declared metric name."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        fname = (f.id if isinstance(f, ast.Name)
+                 else f.attr if isinstance(f, ast.Attribute) else None)
+        if fname != "SLORule":
+            continue
+        metric_arg = None
+        for kw in node.keywords:
+            if kw.arg == "metric":
+                metric_arg = kw.value
+        if metric_arg is None and len(node.args) >= 2:
+            metric_arg = node.args[1]
+        if metric_arg is None:
+            out.append((node.lineno, "no metric argument"))
+            continue
+        metric = _resolve_string_expr(metric_arg)
+        if metric is _UNRESOLVED:
+            out.append((node.lineno,
+                        "metric references an undeclared module "
+                        "constant"))
+            continue
+        if metric is None:
+            continue  # dynamic: SLORule's runtime validation covers it
+        if metric in _telemetry.CANONICAL_METRIC_NAMES:
+            continue
+        prefix = _telemetry.HEALTH_METRIC_PREFIX
+        if (metric.startswith(prefix)
+                and metric[len(prefix):] in _HEALTH_EVENT_VALUES):
+            continue
+        out.append((node.lineno, f"undeclared metric {metric!r}"))
+    return out
+
+
+@register
+class SLOMetricsRule(Rule):
+    id = "slo-metrics"
+    title = "SLORule metrics must statically resolve to declared names"
+    rationale = (
+        "A typo'd metric watches nothing forever. SLORule's runtime "
+        "validation catches dynamic cases; this rule catches literals "
+        "and module-constant concatenations before any scope ever "
+        "runs — including a typo'd MODULE CONSTANT, which would "
+        "otherwise only surface at import time.")
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        return [self.finding(
+            src, line,
+            f"SLO rule metric: {reason} — must be a "
+            "CANONICAL_METRIC_NAMES entry or a sparkdl.health.<event> "
+            "mirror of a core/health.py constant")
+            for line, reason in bad_slo_rule_metrics(src.tree)]

@@ -1,0 +1,531 @@
+"""Per-module lock-model extraction for the concurrency rules.
+
+One AST walk per file (memoized on ``SourceFile.cache``) produces a
+:class:`ModuleLockModel`:
+
+- **lock inventory** — ``threading.Lock/RLock/Condition/Semaphore/
+  Event`` objects assigned to module globals (``_pool_lock =
+  threading.Lock()``) or to ``self.<attr>`` inside a class
+  (``self._lock = threading.Lock()``, ``self.cond =
+  threading.Condition()``). Each gets a qualified identity —
+  ``module.py:var`` or ``Class.attr`` — and a kind (``lock`` /
+  ``rlock`` / ``condition`` / ``event``); Conditions default to an
+  internal RLock, so only plain ``lock``s are re-entrancy hazards.
+- **per-function summaries** — for every function/method: which locks
+  its body acquires (``with lock:`` scopes, plus blocking
+  ``.acquire()`` calls; ``acquire(blocking=False)`` is exempt — it
+  cannot deadlock), the nested-acquisition edges that implies, every
+  ``cond.wait`` site with the locks held around it, every
+  blocking-listed call with the locks held around it, every
+  ``self._x = ...`` attribute store with the locks held around it, and
+  the ``self.method()`` / same-module ``function()`` calls made while
+  holding locks (for one-module-deep interprocedural propagation: a
+  helper that blocks, called under a lock, is the caller's hazard).
+
+Resolution is deliberately static and conservative: ``self.X`` resolves
+through the enclosing class's lock inventory, ``param.X`` resolves when
+the parameter is annotated with a same-module class name (the
+``state: _FnState`` idiom in ``core/executor.py``), module globals
+resolve by name. Anything else — attributes on locals, cross-object
+chains — is left unresolved and unreported rather than guessed at.
+Nested function definitions are scanned with an EMPTY held-set (their
+bodies run later, on an unknown thread, not at the definition site).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from sparkdl_tpu.analysis.framework import SourceFile
+
+_FACTORY_KINDS = {
+    "Lock": "lock",
+    "RLock": "rlock",
+    "Condition": "condition",
+    "Semaphore": "lock",
+    "BoundedSemaphore": "lock",
+    "Event": "event",
+}
+
+#: Kinds that guard shared state (Events signal, they don't guard).
+GUARD_KINDS = ("lock", "rlock", "condition")
+
+
+@dataclass(frozen=True)
+class Lock:
+    """One lock object's static identity."""
+
+    qualname: str  # "Class.attr" or "module.py:var"
+    kind: str      # lock | rlock | condition | event
+
+
+@dataclass(frozen=True)
+class HeldLock:
+    lock: Lock
+    line: int  # where it was acquired
+
+
+@dataclass
+class FunctionSummary:
+    """Everything the concurrency rules need to know about one
+    function/method body."""
+
+    qualname: str
+    lineno: int
+    acquired: List[Tuple[Lock, int]] = field(default_factory=list)
+    edges: List[Tuple[Lock, Lock, int]] = field(default_factory=list)
+    waits: List[Tuple[Lock, int, Tuple[HeldLock, ...]]] = \
+        field(default_factory=list)
+    blocking: List[Tuple[str, int, Tuple[HeldLock, ...]]] = \
+        field(default_factory=list)
+    attr_writes: List[Tuple[str, int, Tuple[HeldLock, ...]]] = \
+        field(default_factory=list)
+    calls: List[Tuple[Tuple[str, str], int, Tuple[HeldLock, ...]]] = \
+        field(default_factory=list)
+
+
+@dataclass
+class ClassModel:
+    name: str
+    lock_attrs: Dict[str, Lock] = field(default_factory=dict)
+    methods: Dict[str, FunctionSummary] = field(default_factory=dict)
+
+    @property
+    def guard_locks(self) -> List[Lock]:
+        return [lk for lk in self.lock_attrs.values()
+                if lk.kind in GUARD_KINDS]
+
+
+@dataclass
+class ModuleLockModel:
+    rel: str
+    module_locks: Dict[str, Lock] = field(default_factory=dict)
+    classes: Dict[str, ClassModel] = field(default_factory=dict)
+    functions: Dict[str, FunctionSummary] = field(default_factory=dict)
+    threads: List[Tuple[int, bool]] = field(default_factory=list)
+    # (lineno, has_name) per threading.Thread(...) creation
+    has_join: bool = False
+
+    def summary(self, key: Tuple[str, str]) -> Optional[FunctionSummary]:
+        scope, name = key
+        if scope:
+            cls = self.classes.get(scope)
+            return cls.methods.get(name) if cls else None
+        return self.functions.get(name)
+
+    def all_summaries(self) -> List[Tuple[Tuple[str, str],
+                                          FunctionSummary]]:
+        out: List[Tuple[Tuple[str, str], FunctionSummary]] = []
+        for name, s in self.functions.items():
+            out.append((("", name), s))
+        for cname, cls in self.classes.items():
+            for mname, s in cls.methods.items():
+                out.append(((cname, mname), s))
+        return out
+
+
+def _factory_kind(value: ast.expr) -> Optional[str]:
+    """``threading.Lock()`` / ``Lock()`` → "lock", etc."""
+    if not isinstance(value, ast.Call):
+        return None
+    f = value.func
+    name = (f.attr if isinstance(f, ast.Attribute)
+            else f.id if isinstance(f, ast.Name) else None)
+    return _FACTORY_KINDS.get(name) if name else None
+
+
+def _is_thread_factory(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return (f.attr == "Thread" and isinstance(f.value, ast.Name)
+                and f.value.id == "threading")
+    return isinstance(f, ast.Name) and f.id == "Thread"
+
+
+def _nonblocking_acquire(call: ast.Call) -> bool:
+    """``.acquire(blocking=False)`` / ``.acquire(False)`` — cannot
+    deadlock, so it is neither an ordering edge nor a blocking call."""
+    for kw in call.keywords:
+        if (kw.arg == "blocking" and isinstance(kw.value, ast.Constant)
+                and kw.value.value is False):
+            return True
+    return bool(call.args and isinstance(call.args[0], ast.Constant)
+                and call.args[0].value is False)
+
+
+def is_thread_join(call: ast.Call) -> bool:
+    """``x.join()`` shaped like a thread/process join — no arguments, a
+    ``timeout=`` kwarg, or a single numeric timeout — on a receiver
+    that is not a string literal or ``os.path``. ``sep.join(items)``
+    (an iterable argument) is str.join, not a wait."""
+    f = call.func
+    if not (isinstance(f, ast.Attribute) and f.attr == "join"):
+        return False
+    value = f.value
+    if isinstance(value, ast.Constant):
+        return False  # ", ".join(...)
+    if isinstance(value, ast.Attribute) and value.attr == "path":
+        return False  # os.path.join
+    if any(kw.arg != "timeout" for kw in call.keywords):
+        return False
+    if len(call.args) > 1:
+        return False
+    if call.args and not (isinstance(call.args[0], ast.Constant)
+                          and isinstance(call.args[0].value,
+                                         (int, float))):
+        return False  # sep.join(items): a real iterable argument
+    return True
+
+
+def blocking_call_desc(call: ast.Call) -> Optional[str]:
+    """Human-readable descriptor when ``call`` is on the blocking-call
+    list (docs/ANALYSIS.md ``blocking-under-lock``), else None."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        return "open() (file I/O)" if f.id == "open" else None
+    if not isinstance(f, ast.Attribute):
+        return None
+    attr, value = f.attr, f.value
+    vname = value.id if isinstance(value, ast.Name) else None
+    if attr == "sleep" and vname == "time":
+        return "time.sleep()"
+    if attr == "result":
+        return ".result() (future wait)"
+    if attr == "join":
+        return (".join() (thread/process wait)"
+                if is_thread_join(call) else None)
+    if attr == "asarray" and vname in ("np", "numpy"):
+        return "np.asarray() (device fetch)"
+    if attr == "device_get":
+        return "device_get() (device fetch)"
+    if attr == "block_until_ready":
+        return "block_until_ready() (device sync)"
+    if attr == "execute" and vname in ("executor", "_executor",
+                                       "device_executor"):
+        return "executor.execute() (device entry)"
+    if attr == "write":
+        return ".write() (file write)"
+    if vname == "subprocess" and attr in ("run", "call", "check_call",
+                                          "check_output"):
+        return f"subprocess.{attr}()"
+    if attr == "wait" and vname in ("futures", "_futures"):
+        return "futures.wait()"
+    return None
+
+
+class _ModuleScanner:
+    """One pass over a module building the :class:`ModuleLockModel`."""
+
+    def __init__(self, src: SourceFile) -> None:
+        self.src = src
+        self.model = ModuleLockModel(rel=src.rel)
+
+    # -- inventory (first pass) ---------------------------------------------
+
+    def _collect_inventory(self, tree: ast.Module) -> None:
+        for node in tree.body:
+            if isinstance(node, ast.Assign):
+                kind = _factory_kind(node.value)
+                if kind:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self.model.module_locks[t.id] = Lock(
+                                f"{self.model.rel}:{t.id}", kind)
+        # EVERY class in the module gets its own inventory — including
+        # classes nested in methods (the fitMultiple iterator idiom):
+        # their self.<attr> locks belong to THEM, not the enclosing
+        # class, so the write/blocking rules judge the right owner
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                self._collect_class_inventory(node)
+
+    def _collect_class_inventory(self, cls: ast.ClassDef) -> None:
+        model = self.model.classes.setdefault(cls.name,
+                                              ClassModel(cls.name))
+
+        def walk_own(node: ast.AST):
+            """ast.walk pruned at nested ClassDef boundaries — a nested
+            class's ``self`` is not this class's ``self``."""
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    continue
+                yield child
+                yield from walk_own(child)
+
+        for node in walk_own(cls):
+            if isinstance(node, ast.Assign):
+                kind = _factory_kind(node.value)
+                if not kind:
+                    continue
+                for t in node.targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        # module-qualified: two modules may both define
+                        # `class Worker` with a `_lock` — distinct lock
+                        # objects must be distinct graph nodes, or the
+                        # merged lock-order graph invents phantom
+                        # cycles (same-named classes within ONE module
+                        # still collide — accepted limitation)
+                        model.lock_attrs[t.attr] = Lock(
+                            f"{self.model.rel}:{cls.name}.{t.attr}",
+                            kind)
+
+    # -- resolution ----------------------------------------------------------
+
+    def _resolve(self, expr: ast.expr, cls: Optional[ClassModel],
+                 annotations: Dict[str, str]) -> Optional[Lock]:
+        if isinstance(expr, ast.Name):
+            return self.model.module_locks.get(expr.id)
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)):
+            base, attr = expr.value.id, expr.attr
+            if base == "self" and cls is not None:
+                return cls.lock_attrs.get(attr)
+            ann = annotations.get(base)
+            if ann is not None and ann in self.model.classes:
+                return self.model.classes[ann].lock_attrs.get(attr)
+            # unique-attribute fallback: `state.cond` on an UNANNOTATED
+            # local still resolves when exactly one class in the module
+            # owns a lock attr of that name (the `state = self._state(…)`
+            # idiom in core/executor.py); an ambiguous attr name stays
+            # unresolved rather than guessed at
+            owners = self._attr_owners().get(attr, ())
+            if len(owners) == 1:
+                return owners[0]
+        return None
+
+    def _attr_owners(self) -> Dict[str, List[Lock]]:
+        owners = self.model.__dict__.get("_attr_owners_cache")
+        if owners is None:
+            owners = {}
+            for c in self.model.classes.values():
+                for attr, lk in c.lock_attrs.items():
+                    owners.setdefault(attr, []).append(lk)
+            self.model.__dict__["_attr_owners_cache"] = owners
+        return owners
+
+    # -- per-function scan ---------------------------------------------------
+
+    @staticmethod
+    def _annotations(func: ast.FunctionDef) -> Dict[str, str]:
+        """param name → annotated same-module class name (``state:
+        _FnState`` and the quoted-forward-ref form)."""
+        out: Dict[str, str] = {}
+        args = list(func.args.posonlyargs) + list(func.args.args) \
+            + list(func.args.kwonlyargs)
+        for a in args:
+            ann = a.annotation
+            if isinstance(ann, ast.Name):
+                out[a.arg] = ann.id
+            elif (isinstance(ann, ast.Constant)
+                    and isinstance(ann.value, str)):
+                out[a.arg] = ann.value.strip('"\'')
+        return out
+
+    def _scan_function(self, func: ast.FunctionDef,
+                       cls: Optional[ClassModel]) -> FunctionSummary:
+        qual = (f"{cls.name}.{func.name}" if cls else func.name)
+        return self._scan_stmts(func.body, qual, func.lineno, cls,
+                                self._annotations(func))
+
+    def _scan_stmts(self, stmts, qual: str, lineno: int,
+                    cls: Optional[ClassModel],
+                    annotations: Dict[str, str]) -> FunctionSummary:
+        s = FunctionSummary(qualname=qual, lineno=lineno)
+
+        def handle_call(node: ast.Call,
+                        held: Tuple[HeldLock, ...]) -> None:
+            f = node.func
+            if _is_thread_factory(node):
+                has_name = any(kw.arg == "name" for kw in node.keywords)
+                self.model.threads.append((node.lineno, has_name))
+            if is_thread_join(node):
+                self.model.has_join = True
+            if isinstance(f, ast.Attribute):
+                if f.attr == "acquire":
+                    lk = self._resolve(f.value, cls, annotations)
+                    if lk is not None and not _nonblocking_acquire(node):
+                        for h in held:
+                            s.edges.append((h.lock, lk, node.lineno))
+                        s.acquired.append((lk, node.lineno))
+                    return
+                if f.attr == "wait":
+                    lk = self._resolve(f.value, cls, annotations)
+                    if lk is not None:
+                        if lk.kind == "condition":
+                            s.waits.append((lk, node.lineno, held))
+                            return
+                        if lk.kind == "event":
+                            s.blocking.append(("Event.wait()",
+                                               node.lineno, held))
+                            return
+            desc = blocking_call_desc(node)
+            if desc is not None:
+                s.blocking.append((desc, node.lineno, held))
+            # call-graph edges for one-module interprocedural checks
+            if (isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "self" and cls is not None):
+                s.calls.append(((cls.name, f.attr), node.lineno, held))
+            elif isinstance(f, ast.Name):
+                s.calls.append((("", f.id), node.lineno, held))
+
+        def record_write_targets(targets: Sequence[ast.expr], line: int,
+                                 held: Tuple[HeldLock, ...]) -> None:
+            for t in targets:
+                if isinstance(t, (ast.Tuple, ast.List)):
+                    record_write_targets(t.elts, line, held)
+                elif (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    s.attr_writes.append((t.attr, line, held))
+
+        def visit(node: ast.AST, held: Tuple[HeldLock, ...]) -> None:
+            if isinstance(node, ast.ClassDef):
+                # a nested class's methods are scanned as THAT class's
+                # methods (see scan()), not as part of this function
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                # a nested def's body runs later, with unknown locks
+                # held — scan it with an empty held-set
+                for child in ast.iter_child_nodes(node):
+                    visit(child, ())
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                inner = held
+                for item in node.items:
+                    visit(item.context_expr, inner)
+                    lk = self._resolve(item.context_expr, cls,
+                                       annotations)
+                    if lk is not None:
+                        for h in inner:
+                            s.edges.append((h.lock, lk, node.lineno))
+                        s.acquired.append((lk, node.lineno))
+                        inner = inner + (HeldLock(lk, node.lineno),)
+                for stmt in node.body:
+                    visit(stmt, inner)
+                return
+            if isinstance(node, ast.Call):
+                handle_call(node, held)
+            elif isinstance(node, ast.Assign):
+                record_write_targets(node.targets, node.lineno, held)
+            elif isinstance(node, ast.AugAssign):
+                record_write_targets([node.target], node.lineno, held)
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                record_write_targets([node.target], node.lineno, held)
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for stmt in stmts:
+            visit(stmt, ())
+        return s
+
+    # -- driver --------------------------------------------------------------
+
+    def scan(self) -> ModuleLockModel:
+        tree = self.src.tree
+        self._collect_inventory(tree)
+
+        # every class's IMMEDIATE methods, wherever the class lives
+        # (module level, nested in a class, nested in a method)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            cls_model = self.model.classes[node.name]
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    cls_model.methods[item.name] = \
+                        self._scan_function(item, cls_model)
+        # module-level functions (the same-module propagation targets)
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.model.functions.setdefault(
+                    node.name, self._scan_function(node, None))
+        # import-time statements: a Thread started (or a lock held) at
+        # module level must not be invisible to the rules
+        module_stmts = [
+            stmt for stmt in tree.body
+            if not isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef,
+                                     ast.ClassDef))]
+        self.model.functions.setdefault(
+            "<module>",
+            self._scan_stmts(module_stmts, "<module>", 1, None, {}))
+        return self.model
+
+
+def module_model(src: SourceFile) -> ModuleLockModel:
+    """The (memoized) lock model for one parsed file."""
+    model = src.cache.get("lock_model")
+    if model is None:
+        model = _ModuleScanner(src).scan()
+        src.cache["lock_model"] = model
+    return model
+
+
+# ---------------------------------------------------------------------------
+# One-module-deep interprocedural closures
+# ---------------------------------------------------------------------------
+
+
+def _closure(model: ModuleLockModel, extract) -> Dict[Tuple[str, str],
+                                                      List]:
+    """Transitive closure of ``extract(summary)`` items over the
+    same-module call graph (self-methods + module functions). Items are
+    ``(payload..., via)`` tuples; ``via`` names the function the item
+    physically lives in.
+
+    Computed as a fixpoint (sets unioned until stable) rather than a
+    memoized DFS: mutually-recursive helpers form call cycles, and a
+    cycle participant visited mid-traversal must not have a PARTIAL
+    reachable set cached — that would silently drop real hazards
+    depending on traversal order. The per-module graphs are tiny."""
+    result: Dict[Tuple[str, str], Set] = {}
+    calls: Dict[Tuple[str, str], List[Tuple[str, str]]] = {}
+    for key, s in model.all_summaries():
+        result[key] = {item + (s.qualname,) for item in extract(s)}
+        calls[key] = [callee for callee, _line, _held in s.calls]
+    changed = True
+    while changed:
+        changed = False
+        for key, callees in calls.items():
+            mine = result[key]
+            for callee in callees:
+                theirs = result.get(callee)
+                if theirs and not theirs <= mine:
+                    mine |= theirs
+                    changed = True
+    # deterministic item order (Lock dataclasses aren't orderable; repr
+    # is stable) so downstream first-site anchoring never jitters
+    return {key: sorted(items, key=repr)
+            for key, items in result.items()}
+
+
+def reachable_acquired(model: ModuleLockModel) -> Dict[Tuple[str, str],
+                                                       List]:
+    """key → [(Lock, line, via)] acquired in the function or any
+    same-module callee."""
+    return _closure(model, lambda s: [(lk, line)
+                                      for lk, line in s.acquired])
+
+
+def reachable_blocking(model: ModuleLockModel) -> Dict[Tuple[str, str],
+                                                       List]:
+    """key → [(desc, line, via)] blocking sites in the function or any
+    same-module callee (held-or-not at the site — the caller's held
+    locks are what make them hazards)."""
+    return _closure(model, lambda s: [(desc, line)
+                                      for desc, line, _h in s.blocking])
+
+
+def reachable_waits(model: ModuleLockModel) -> Dict[Tuple[str, str],
+                                                    List]:
+    """key → [(condition Lock, line, via)] condition-wait sites in the
+    function or any same-module callee."""
+    return _closure(model, lambda s: [(lk, line)
+                                      for lk, line, _h in s.waits])

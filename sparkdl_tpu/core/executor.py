@@ -535,7 +535,7 @@ class DeviceExecutor:
 
         try:
             out = request.future.result()  # isolated failures raise here
-        except BaseException as e:  # taxonomy-ok: breaker accounting, then re-raised
+        except BaseException as e:  # sparkdl: allow(broad-retry): breaker accounting only — re-raised below, never retried here
             # once per REQUEST, not per waiter: two hedged waiters share
             # one dedup'd future, and a launch-plumbing failure already
             # noted (and marked) every window member in the coalescer
@@ -799,7 +799,7 @@ class DeviceExecutor:
         chain ends in one success note)."""
         try:
             yield
-        except BaseException as e:  # taxonomy-ok: breaker accounting, then re-raised
+        except BaseException as e:  # sparkdl: allow(broad-retry): breaker accounting only — re-raised, never retried here
             self._breaker_note(state, e, is_probe=is_probe)
             raise
         else:
@@ -996,7 +996,7 @@ class DeviceExecutor:
                     continue  # the whole window expired unlaunched
                 try:
                     self._launch(state, batch, total)
-                except BaseException as e:  # taxonomy-ok: not a retry — the error is delivered to every drained future
+                except BaseException as e:  # sparkdl: allow(broad-retry): not a retry — the error is delivered to every drained future
                     # a failure in the launch plumbing itself (concat,
                     # slicing) must still complete every drained future —
                     # the batch already left `pending`, so the terminal

@@ -255,19 +255,30 @@ class DevicePrefetcher:
     # -- lifecycle -----------------------------------------------------------
 
     def _finish(self) -> None:
-        """Normal end of stream: join the (already exiting) thread."""
-        self._closed = True
+        """Normal end of stream: join the (already exiting) thread.
+        Shares close()'s atomic check-and-set: a close() racing the
+        consumer's end-of-stream (e.g. __del__ on the GC thread) must
+        not null _thread between this method's check and its join."""
+        with self._lock:
+            if self._closed:
+                return  # a racing close() already joined and reported
+            self._closed = True
         if self._thread is not None:
             self._thread.join()
-            self._thread = None
+            with self._lock:
+                self._thread = None
         self._report()
 
     def close(self) -> None:
         """Abort the stream: wake + join the staging thread, drop staged
-        items. Idempotent; safe mid-stream and after exhaustion."""
-        if self._closed:
-            return
-        self._closed = True
+        items. Idempotent; safe mid-stream and after exhaustion — the
+        closed check-and-set is atomic under the lock, so two racing
+        closers (consumer + __del__, or two threads sharing the
+        prefetcher) can't both run the join/drain/report sequence."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
         if self._thread is not None:
             self._stop.set()
             # drain so a producer blocked on put() can notice stop quickly
@@ -277,13 +288,15 @@ class DevicePrefetcher:
                 except queue.Empty:
                     break
             self._thread.join()
-            self._thread = None
+            with self._lock:
+                self._thread = None
         self._report()
 
     def _report(self) -> None:
-        if self._reported or not self._report_health:
-            return
-        self._reported = True
+        with self._lock:
+            if self._reported or not self._report_health:
+                return
+            self._reported = True
         health.record(health.PREFETCH_REPORT, name=self.name,
                       depth=self.depth, **self.stats.as_dict())
 

@@ -895,6 +895,7 @@ class SnapshotExporter:
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> None:
+        # sparkdl: allow(unguarded-shared-write): set once, before the exporter thread exists
         self._thread = threading.Thread(
             target=self._loop, daemon=True,
             name=f"sparkdl-telemetry-export-{self.tel.run_id}")
@@ -914,6 +915,7 @@ class SnapshotExporter:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=10.0)
+            # sparkdl: allow(unguarded-shared-write): the exporter thread is joined; only close() writes this
             self._thread = None
         self.tick(final=True)
 
@@ -924,6 +926,7 @@ class SnapshotExporter:
         now = _monotonic()
         if now < self._next_due:
             return False
+        # sparkdl: allow(unguarded-shared-write): cadence state touched only by the exporter thread (close() only flushes)
         self._next_due = now + self.interval_s
         self.tick()
         return True
@@ -966,12 +969,16 @@ class SnapshotExporter:
             snap["final"] = True
         self._timeline.append(self._compact(snap))
         if self.snapshot_path is not None:
+            # sparkdl: allow(blocking-under-lock): serializing these writes against the close() flush is _tick_lock's whole job
             with open(self.snapshot_path, "a") as f:
+                # sparkdl: allow(blocking-under-lock): see the open() above — one writer at a time by design
                 f.write(json.dumps(snap, default=str) + "\n")
                 f.flush()
         if self.prom_path is not None:
             tmp = self.prom_path + ".tmp"
+            # sparkdl: allow(blocking-under-lock): serializing these writes against the close() flush is _tick_lock's whole job
             with open(tmp, "w") as f:
+                # sparkdl: allow(blocking-under-lock): see the open() above — one writer at a time by design
                 f.write(tel.metrics.prometheus_text())
             os.replace(tmp, self.prom_path)
 

@@ -38,6 +38,7 @@ def _try_build() -> bool:
     import subprocess
 
     try:
+        # sparkdl: allow(blocking-under-lock): one-shot native build on first load; _lib_lock exists to serialize exactly this
         subprocess.run(["bash", script], check=True, capture_output=True,
                        timeout=120)
     except Exception:

@@ -140,6 +140,32 @@ def test_close_midstream_wakes_blocked_producer():
     pf.close()  # idempotent
 
 
+def test_finish_and_close_are_mutually_idempotent():
+    """The lifecycle check-and-set is atomic (PR 8 concurrency-analyzer
+    fix): whichever of close()/end-of-stream _finish wins, the loser is
+    a no-op — never an AttributeError on a nulled _thread, never a
+    double prefetch_report."""
+    with HealthMonitor("finish-close") as mon:
+        pf = DevicePrefetcher(range(3), depth=2, report_health=True)
+        list(pf)      # exhausts the stream -> _finish() ran
+        pf.close()    # racing/late close: no-op
+        pf._finish()  # and the reverse order: no-op too
+        assert pf._thread is None
+        assert mon.count(health.PREFETCH_REPORT) == 1
+
+    with HealthMonitor("close-finish") as mon:
+        pf = DevicePrefetcher(range(1000), depth=2, report_health=True)
+        next(pf)
+        closers = [threading.Thread(target=pf.close,
+                                    name=f"closer-{i}")
+                   for i in range(8)]
+        [t.start() for t in closers]
+        [t.join() for t in closers]
+        pf._finish()  # consumer losing the race to a closer: no-op
+        assert _wait_no_prefetch_threads()
+        assert mon.count(health.PREFETCH_REPORT) == 1
+
+
 def test_stall_counters_feed_host_wait_phase():
     profiling.reset_phase_stats()
 

@@ -1,0 +1,11 @@
+"""Seeded violation: time.sleep under a held lock (blocking-under-lock)."""
+
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+def tick():
+    with _lock:
+        time.sleep(0.1)

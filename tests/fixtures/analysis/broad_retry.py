@@ -1,0 +1,11 @@
+"""Seeded violation: blind broad-except retry loop (broad-retry)."""
+
+
+def flaky(op):
+    last = None
+    for _attempt in range(3):
+        try:
+            return op()
+        except Exception as e:
+            last = e
+    return last

@@ -1,0 +1,7 @@
+"""Seeded violation: bare-string health event (health-constants)."""
+
+from sparkdl_tpu.core import health
+
+
+def run(partition):
+    health.record('task_retried', partition=partition)

@@ -1,0 +1,20 @@
+"""Seeded violation: two locks taken in opposite orders (lock-order)."""
+
+import threading
+
+
+class TwoLocks:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._n = 0
+
+    def forward(self):
+        with self._a:
+            with self._b:
+                self._n += 1
+
+    def backward(self):
+        with self._b:
+            with self._a:
+                self._n -= 1

@@ -1,0 +1,8 @@
+"""Seeded violation: direct device entry on the featurize route
+(executor-choke-point; the `ml/` path segment puts this in scope)."""
+
+
+def apply_partition(model, batch, mesh):
+    fn = model.jitted(mesh=mesh)
+    del fn
+    return model.apply_batch(batch, batch_size=64)

@@ -1,0 +1,8 @@
+"""Seeded violation: typo'd SLO metric name (slo-metrics)."""
+
+from sparkdl_tpu.core.slo import SLORule
+
+RULES = [
+    SLORule('queue-wait', metric='sparkdl.executor.queue_wait_ss',
+            window_s=30.0, threshold=1.0),
+]

@@ -1,0 +1,8 @@
+"""Seeded violation: typo'd span name (span-names)."""
+
+from sparkdl_tpu.core import profiling
+
+
+def run(step):
+    with profiling.annotate('sparkdl.train_stepp'):
+        return step()

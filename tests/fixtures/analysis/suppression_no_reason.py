@@ -1,0 +1,13 @@
+"""Seeded violation: a justification-less suppression neither
+suppresses (blocking-under-lock still fires) nor passes hygiene
+(suppression-hygiene fires on the directive)."""
+
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+def tick():
+    with _lock:
+        time.sleep(0.1)  # sparkdl: allow(blocking-under-lock)

@@ -1,0 +1,9 @@
+"""Seeded violation: unnamed, unjoinable thread (thread-lifecycle)."""
+
+import threading
+
+
+def fire_and_forget(fn):
+    t = threading.Thread(target=fn, daemon=True)
+    t.start()
+    return t

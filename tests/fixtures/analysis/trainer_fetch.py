@@ -1,0 +1,14 @@
+"""Seeded violation: blocking fetch in the step loop
+(blocking-fetch-in-fit)."""
+
+
+class Trainer:
+    def fit(self, state, batches):
+        def sync(st):
+            return int(st.step)  # helper definition: exempt
+
+        for x, y in batches:
+            state, metrics = self.step(state, x, y)
+            step_n = int(state.step)  # the per-step blocking fetch
+            self.log(step_n, metrics)
+        return state

@@ -1,0 +1,16 @@
+"""Seeded violation: self._* store with no lock (unguarded-shared-write)."""
+
+import threading
+
+
+class RacyCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def bump(self):
+        self._n += 1
+
+    def read(self):
+        with self._lock:
+            return self._n

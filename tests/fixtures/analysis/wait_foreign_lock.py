@@ -1,0 +1,16 @@
+"""Seeded violation: cond.wait holding another lock (wait-holding-lock)."""
+
+import threading
+
+
+class Waiter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition()
+        self._ready = False
+
+    def stall(self):
+        with self._lock:
+            with self._cond:
+                while not self._ready:
+                    self._cond.wait(timeout=1.0)

@@ -1,97 +1,61 @@
-"""Static check: no bare `except Exception: retry` loops bypassing
-core.resilience.classify (ISSUE 2 satellite; keeps the error taxonomy the
-single source of truth).
+"""The six one-off AST lints (ISSUEs 2–7), now thin wrappers over the
+shared analysis framework (ISSUE 8).
 
-The rule: inside a `for`/`while` loop, a broad handler (`except:`,
-`except Exception`, `except BaseException`) must either re-raise
-somewhere in its body or consult the taxonomy (reference `classify` or
-the `resilience` module). A handler that swallows broadly and lets the
-loop re-attempt is exactly the blind-retry shape PR 1/2 removed — FATAL
-user errors would be silently replayed.
-
-Deliberate broad swallows that are NOT retries (per-row degradation that
-re-raises conditionally already passes; anything else) can opt out with a
-`# taxonomy-ok: <reason>` comment on the `except` line.
+Each lint lives as a registered rule in ``sparkdl_tpu/analysis/lints.py``
+— one engine, one suppression syntax (``# sparkdl: allow(<rule>):
+<why>``), one catalog (docs/ANALYSIS.md). The package-wide tests here
+invoke the analyzer per rule (so suppressions work exactly as in the
+CLI); each self-test seeds the original violation shape through the
+framework and asserts the registered rule still flags it — the
+typo/self-test coverage the standalone lints had is preserved
+verbatim. The full-catalog gate (every rule at once, plus the
+concurrency pack) is ``tests/test_analysis.py``.
 """
 
 import ast
 import pathlib
 
+from sparkdl_tpu import analysis
+from sparkdl_tpu.analysis import framework, lints
+from sparkdl_tpu.core import health as _health
+from sparkdl_tpu.core import telemetry as _telemetry
+
 ROOT = pathlib.Path(__file__).resolve().parent.parent / "sparkdl_tpu"
 
-_BROAD = {"Exception", "BaseException"}
+
+def _package_findings(rule_id):
+    """Run ONE rule over the package through the framework (inline
+    suppressions apply, the shipped empty baseline does not matter)."""
+    return analysis.analyze(paths=[ROOT], rule_ids=[rule_id]).findings
 
 
-def _is_broad(handler: ast.ExceptHandler) -> bool:
-    t = handler.type
-    if t is None:
-        return True
-    if isinstance(t, ast.Name):
-        return t.id in _BROAD
-    if isinstance(t, ast.Tuple):
-        return any(isinstance(e, ast.Name) and e.id in _BROAD
-                   for e in t.elts)
-    return False
+def _seed(rule_id, source, rel="seed.py"):
+    """Seed a violation through the framework; the registered rule must
+    flag it (lines returned sorted)."""
+    src = framework.SourceFile.from_source(source, rel=rel)
+    res = analysis.analyze_sources([src], rule_ids=[rule_id])
+    return sorted(f.line for f in res.findings)
 
 
-def _consults_taxonomy_or_raises(handler: ast.ExceptHandler) -> bool:
-    for node in ast.walk(handler):
-        if isinstance(node, ast.Raise):
-            return True
-        if isinstance(node, ast.Name) and node.id in ("classify",
-                                                      "resilience"):
-            return True
-        if isinstance(node, ast.Attribute) and node.attr == "classify":
-            return True
-    return False
-
-
-class _LoopHandlerVisitor(ast.NodeVisitor):
-    def __init__(self, source_lines):
-        self.loop_depth = 0
-        self.lines = source_lines
-        self.violations = []
-
-    def _loop(self, node):
-        self.loop_depth += 1
-        self.generic_visit(node)
-        self.loop_depth -= 1
-
-    visit_For = visit_While = visit_AsyncFor = _loop
-
-    def visit_Try(self, node):
-        for handler in node.handlers:
-            if (self.loop_depth > 0 and _is_broad(handler)
-                    and not _consults_taxonomy_or_raises(handler)
-                    and "taxonomy-ok" not in
-                    self.lines[handler.lineno - 1]):
-                self.violations.append(handler.lineno)
-        self.generic_visit(node)
-
-    # TryStar (3.11 except*) gets the same treatment if it ever appears
-    visit_TryStar = visit_Try
+# ---------------------------------------------------------------------------
+# broad-retry (ISSUE 2)
+# ---------------------------------------------------------------------------
 
 
 def test_no_blind_broad_retry_loops():
-    offenders = []
-    for path in sorted(ROOT.rglob("*.py")):
-        source = path.read_text()
-        tree = ast.parse(source, filename=str(path))
-        visitor = _LoopHandlerVisitor(source.splitlines())
-        visitor.visit(tree)
-        offenders.extend(f"{path.relative_to(ROOT.parent)}:{line}"
-                         for line in visitor.violations)
+    offenders = _package_findings("broad-retry")
     assert not offenders, (
         "broad except inside a loop without re-raise or "
         "core.resilience.classify — blind retry would replay FATAL "
-        "errors. Route the handler through resilience.classify (or mark "
-        "a deliberate non-retry swallow with '# taxonomy-ok: <reason>'): "
-        f"{offenders}")
+        "errors. Route the handler through resilience.classify, or mark "
+        "a deliberate non-retry swallow with "
+        "'# sparkdl: allow(broad-retry): <reason>': "
+        f"{[str(f) for f in offenders]}")
 
 
 def test_lint_catches_the_old_blind_retry_shape():
     """Self-test: the pre-supervision `_run_partition` loop (retry every
-    failure blindly) must trip the lint."""
+    failure blindly) must trip the registered rule."""
     bad = (
         "def run(ops, batch):\n"
         "    for attempt in range(3):\n"
@@ -100,101 +64,38 @@ def test_lint_catches_the_old_blind_retry_shape():
         "        except Exception as e:\n"
         "            last = e\n"
     )
-    tree = ast.parse(bad)
-    v = _LoopHandlerVisitor(bad.splitlines())
-    v.visit(tree)
-    assert v.violations == [5]
+    assert _seed("broad-retry", bad) == [5]
 
 
 # ---------------------------------------------------------------------------
-# Async-pipeline lint (ISSUE 3): Trainer.fit's step loop must never block
-# on the device outside the designated sync helpers. A blocking fetch —
-# `int(...)` / `float(...)` on a device scalar, `np.asarray`,
-# `jax.device_get`, `block_until_ready` — inside the loop body
-# re-serializes host staging with device compute (the exact regression the
-# DevicePrefetcher removed). Blocking fetches belong in the pre-loop
-# helper closures (`sync` / `save_checkpoint`), which the loop calls only
-# at sync points; nested function DEFINITIONS are therefore exempt, direct
-# calls in the loop body are not.
+# blocking-fetch-in-fit (ISSUE 3)
 # ---------------------------------------------------------------------------
-
-_BLOCKING_NAMES = {"int", "float"}
-_BLOCKING_ATTRS = {"asarray", "device_get", "block_until_ready"}
-
-
-def _blocking_calls_in_fit_loops(tree: ast.AST):
-    """Lines of blocking-fetch calls inside Trainer.fit's own loops."""
-    fit = None
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ClassDef) and node.name == "Trainer":
-            for item in node.body:
-                if isinstance(item, ast.FunctionDef) and item.name == "fit":
-                    fit = item
-    assert fit is not None, "Trainer.fit not found"
-
-    class _LoopFinder(ast.NodeVisitor):
-        """Collect fit's own loops, NOT those inside nested functions
-        (helper closures run off the hot path or at sync points)."""
-
-        def __init__(self):
-            self.loops = []
-
-        def visit_FunctionDef(self, node):
-            if node is not fit:
-                return  # don't descend into nested defs
-            self.generic_visit(node)
-
-        visit_AsyncFunctionDef = visit_FunctionDef
-
-        def _loop(self, node):
-            self.loops.append(node)
-            self.generic_visit(node)
-
-        visit_For = visit_While = visit_AsyncFor = _loop
-
-    finder = _LoopFinder()
-    finder.visit(fit)
-    assert finder.loops, "Trainer.fit has no step loop?"
-
-    def _walk_pruned(node):
-        """ast.walk, but do not descend into nested function definitions:
-        a def inside the loop only BLOCKS if called there — its call-site
-        is what we check."""
-        for child in ast.iter_child_nodes(node):
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                  ast.Lambda)):
-                continue
-            yield child
-            yield from _walk_pruned(child)
-
-    violations = []
-    for loop in finder.loops:
-        for node in _walk_pruned(loop):
-            if not isinstance(node, ast.Call):
-                continue
-            f = node.func
-            if isinstance(f, ast.Name) and f.id in _BLOCKING_NAMES:
-                violations.append(node.lineno)
-            elif isinstance(f, ast.Attribute) and f.attr in _BLOCKING_ATTRS:
-                violations.append(node.lineno)
-    return sorted(set(violations))
 
 
 def test_trainer_step_loop_has_no_blocking_device_fetch():
-    path = ROOT / "train" / "trainer.py"
-    tree = ast.parse(path.read_text(), filename=str(path))
-    offenders = _blocking_calls_in_fit_loops(tree)
+    # vacuity guard: the rule only fires on files defining Trainer.fit,
+    # so prove trainer.py still has one (with loops) before trusting a
+    # clean package run
+    tree = ast.parse((ROOT / "train" / "trainer.py").read_text())
+    fits = [item for node in ast.walk(tree)
+            if isinstance(node, ast.ClassDef) and node.name == "Trainer"
+            for item in node.body
+            if isinstance(item, ast.FunctionDef) and item.name == "fit"]
+    assert fits, "Trainer.fit not found"
+    assert any(isinstance(n, (ast.For, ast.While))
+               for n in ast.walk(fits[0])), "Trainer.fit has no step loop?"
+    offenders = _package_findings("blocking-fetch-in-fit")
     assert not offenders, (
-        "blocking device fetch inside Trainer.fit's step loop (lines "
-        f"{offenders} of train/trainer.py) — int()/float()/np.asarray/"
-        "jax.device_get/block_until_ready there re-serialize the async "
-        "input pipeline. Move the fetch into the designated sync helpers "
-        "(sync/save_checkpoint) and call them only at sync points.")
+        "blocking device fetch inside Trainer.fit's step loop — "
+        "int()/float()/np.asarray/jax.device_get/block_until_ready "
+        "there re-serialize the async input pipeline. Move the fetch "
+        "into the designated sync helpers (sync/save_checkpoint): "
+        f"{[str(f) for f in offenders]}")
 
 
 def test_lint_catches_the_old_per_step_sync_shape():
     """Self-test: the pre-pipeline loop body (`step = int(state.step)`
-    per step, plus a device_get checkpoint fetch) must trip the lint —
+    per step, plus a device_get checkpoint fetch) must trip the rule —
     while helper DEFINITIONS (pre-loop or even inside the loop) stay
     exempt: only their call-sites block."""
     bad = (
@@ -210,79 +111,27 @@ def test_lint_catches_the_old_per_step_sync_shape():
         "            ckpt.save(step_n, jax.device_get(state))\n"  # line 10
         "        return state\n"
     )
-    assert _blocking_calls_in_fit_loops(ast.parse(bad)) == [9, 10]
+    assert _seed("blocking-fetch-in-fit", bad) == [9, 10]
 
 
 # ---------------------------------------------------------------------------
-# Canonical span/phase name lint (ISSUE 4): every name passed to
-# profiling.annotate() or telemetry.span() in sparkdl_tpu/ must be declared
-# in core.telemetry.CANONICAL_SPAN_NAMES — a typo'd phase name would
-# otherwise silently fork a timer (and a trace track) instead of failing.
-# Names arriving as profiling/telemetry module constants resolve through
-# the live modules; dynamic names (the annotate/span wrappers forwarding a
-# parameter) are skipped — only literals and known constants are checkable.
+# span-names (ISSUE 4)
 # ---------------------------------------------------------------------------
-
-from sparkdl_tpu.core import profiling as _profiling  # noqa: E402
-from sparkdl_tpu.core import telemetry as _telemetry  # noqa: E402
-
-_SPAN_CALL_NAMES = {"annotate", "span"}
-
-
-def _resolve_name_arg(arg: ast.expr):
-    """String value of a span-name argument, or None when dynamic."""
-    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
-        return arg.value
-    attr = None
-    if isinstance(arg, ast.Attribute):  # profiling.STAGE_BATCH
-        attr = arg.attr
-    elif isinstance(arg, ast.Name):     # SPAN_RUN inside telemetry.py
-        attr = arg.id
-    if attr is not None:
-        for mod in (_profiling, _telemetry):
-            value = getattr(mod, attr, None)
-            if isinstance(value, str):
-                return value
-    return None
-
-
-def _span_names_in(tree: ast.AST):
-    """(name, lineno) for every statically-resolvable annotate()/span()
-    call in the tree."""
-    out = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call) or not node.args:
-            continue
-        f = node.func
-        fname = (f.id if isinstance(f, ast.Name)
-                 else f.attr if isinstance(f, ast.Attribute) else None)
-        if fname not in _SPAN_CALL_NAMES:
-            continue
-        name = _resolve_name_arg(node.args[0])
-        if name is not None:
-            out.append((name, node.lineno))
-    return out
 
 
 def test_every_span_name_is_canonical():
-    catalog = _telemetry.CANONICAL_SPAN_NAMES
-    offenders = []
-    for path in sorted(ROOT.rglob("*.py")):
-        tree = ast.parse(path.read_text(), filename=str(path))
-        for name, line in _span_names_in(tree):
-            if name not in catalog:
-                offenders.append(
-                    f"{path.relative_to(ROOT.parent)}:{line}: {name!r}")
+    offenders = _package_findings("span-names")
     assert not offenders, (
         "span/phase name not declared in "
         "core.telemetry.CANONICAL_SPAN_NAMES — a typo'd name silently "
         "forks a timer and a trace track. Add the name to the catalog "
-        f"(and docs/OBSERVABILITY.md) or fix the typo: {offenders}")
+        f"(and docs/OBSERVABILITY.md) or fix the typo: "
+        f"{[str(f) for f in offenders]}")
 
 
 def test_span_name_lint_catches_typo_and_resolves_constants():
-    """Self-test: a typo'd literal trips the check; module-constant names
-    resolve to their canonical strings."""
+    """Self-test: a typo'd literal trips the rule; module-constant names
+    resolve to their canonical strings and pass."""
     bad = (
         "from sparkdl_tpu.core import profiling, telemetry\n"
         "with profiling.annotate('sparkdl.train_stepp'):\n"  # typo
@@ -294,68 +143,36 @@ def test_span_name_lint_catches_typo_and_resolves_constants():
         "with telemetry.span(dynamic_name):\n"               # skipped
         "    pass\n"
     )
-    names = _span_names_in(ast.parse(bad))
+    assert _seed("span-names", bad) == [2]
+    # the resolution helper still sees all three checkable names
+    names = lints.span_names_in(ast.parse(bad))
     assert ("sparkdl.train_stepp", 2) in names
     assert ("sparkdl.fit", 4) in names
     assert ("sparkdl.stage_batch", 6) in names
     assert len(names) == 3  # the dynamic name is not checkable
-    resolved = [n for n, _ in names]
     assert "sparkdl.train_stepp" not in _telemetry.CANONICAL_SPAN_NAMES
-    assert all(n in _telemetry.CANONICAL_SPAN_NAMES
-               for n in resolved if n != "sparkdl.train_stepp")
 
 
 # ---------------------------------------------------------------------------
-# Executor choke-point lint (ISSUE 5): the inference data plane's device
-# entry goes through core/executor.py's `execute` — the coalescing choke
-# point. A transformer (or UDF, or engine op) calling `apply_batch` /
-# `jitted` directly would silently regress the featurize route back to
-# per-partition launches, invisible until the next bench round. Only the
-# choke point itself and the model layer it wraps may touch those
-# methods; training (train/) owns its own step programs and is exempt.
+# executor-choke-point (ISSUE 5)
 # ---------------------------------------------------------------------------
-
-_DEVICE_ENTRY_ATTRS = {"apply_batch", "jitted"}
-# The featurize/serving route that MUST go through the executor. The
-# choke point itself (core/executor.py) and the model layer it delegates
-# to (core/model_function.py) live outside these scopes by design; the
-# training path (train/) owns its own step programs and is exempt.
-_CHOKE_SCOPES = ("ml", "udf", "engine", "image")
-
-
-def _direct_device_entry_calls(tree: ast.AST):
-    """Lines of direct `<obj>.apply_batch(...)` / `<obj>.jitted(...)`
-    calls in the tree."""
-    out = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        f = node.func
-        if isinstance(f, ast.Attribute) and f.attr in _DEVICE_ENTRY_ATTRS:
-            out.append(node.lineno)
-    return sorted(out)
 
 
 def test_featurize_route_enters_device_via_executor_choke_point():
-    offenders = []
-    for scope in _CHOKE_SCOPES:
-        for path in sorted((ROOT / scope).rglob("*.py")):
-            tree = ast.parse(path.read_text(), filename=str(path))
-            offenders.extend(
-                f"{path.relative_to(ROOT.parent)}:{line}"
-                for line in _direct_device_entry_calls(tree))
+    offenders = _package_findings("executor-choke-point")
     assert not offenders, (
         "direct apply_batch/jitted call on the engine featurize route — "
         "device entry must go through core.executor.execute (the "
         "coalescing choke point), or concurrent partitions silently "
         "regress to per-partition launches (docs/PERF.md "
         "'Cross-partition coalescing'): "
-        f"{offenders}")
+        f"{[str(f) for f in offenders]}")
 
 
 def test_choke_point_lint_catches_direct_apply_batch():
     """Self-test: the pre-executor transformer shape (calling the model's
-    apply_batch / jitted straight from the partition op) must trip."""
+    apply_batch / jitted straight from the partition op) must trip —
+    when the file lives on the guarded route (ml/)."""
     bad = (
         "def apply_partition(batch):\n"
         "    out = model.apply_batch(stacked, batch_size=64)\n"
@@ -363,74 +180,24 @@ def test_choke_point_lint_catches_direct_apply_batch():
         "    good = device_executor.execute(model, stacked)\n"
         "    return out\n"
     )
-    assert _direct_device_entry_calls(ast.parse(bad)) == [2, 3]
+    assert _seed("executor-choke-point", bad, rel="ml/seed.py") == [2, 3]
+    # the model layer and training path stay out of scope by path
+    assert _seed("executor-choke-point", bad, rel="core/seed.py") == []
 
 
 # ---------------------------------------------------------------------------
-# Health-event name lint (ISSUE 6): every `health.record(...)` call site in
-# sparkdl_tpu/ must pass a constant DECLARED in core/health.py as its event
-# name — a bare string would silently fork a counter (and escape the docs
-# catalog, the chaos accounting, and the sparkdl.health.* telemetry
-# mirrors) on the first typo.
+# health-constants (ISSUE 6)
 # ---------------------------------------------------------------------------
-
-from sparkdl_tpu.core import health as _health  # noqa: E402
-
-#: Event-name constants declared in core/health.py: UPPERCASE module
-#: attributes holding strings.
-_HEALTH_EVENT_CONSTANTS = {
-    name for name in vars(_health)
-    if name.isupper() and isinstance(getattr(_health, name), str)
-}
-
-
-def _bad_health_record_calls(tree: ast.AST):
-    """(lineno, reason) for every `health.record(...)` call whose event
-    argument is not a `health.<CONSTANT>` reference to a string constant
-    declared in core/health.py."""
-    out = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        f = node.func
-        # the framework-wide convention: `health.record(...)` on the
-        # imported module object (never `from ... import record`)
-        if not (isinstance(f, ast.Attribute) and f.attr == "record"
-                and isinstance(f.value, ast.Name)
-                and f.value.id == "health"):
-            continue
-        if not node.args:
-            out.append((node.lineno, "no event argument"))
-            continue
-        arg = node.args[0]
-        if (isinstance(arg, ast.Constant) and isinstance(arg.value, str)):
-            out.append((node.lineno, f"bare string {arg.value!r}"))
-            continue
-        if not (isinstance(arg, ast.Attribute)
-                and isinstance(arg.value, ast.Name)
-                and arg.value.id == "health"):
-            out.append((node.lineno, "event name is not a "
-                                     "health.<CONSTANT> reference"))
-            continue
-        if arg.attr not in _HEALTH_EVENT_CONSTANTS:
-            out.append((node.lineno,
-                        f"health.{arg.attr} is not declared in "
-                        "core/health.py"))
-    return out
 
 
 def test_every_health_record_uses_a_declared_constant():
-    offenders = []
-    for path in sorted(ROOT.rglob("*.py")):
-        tree = ast.parse(path.read_text(), filename=str(path))
-        for line, reason in _bad_health_record_calls(tree):
-            offenders.append(
-                f"{path.relative_to(ROOT.parent)}:{line}: {reason}")
+    offenders = _package_findings("health-constants")
     assert not offenders, (
         "health.record() call site not using a constant declared in "
-        "core/health.py — a typo'd or ad-hoc event name silently forks a "
-        "counter outside the docs catalog and the telemetry mirror. "
-        f"Declare the event in core/health.py and reference it: {offenders}")
+        "core/health.py — a typo'd or ad-hoc event name silently forks "
+        "a counter outside the docs catalog and the telemetry mirror. "
+        f"Declare the event and reference it: "
+        f"{[str(f) for f in offenders]}")
 
 
 def test_health_record_lint_catches_typos_and_bare_strings():
@@ -444,118 +211,30 @@ def test_health_record_lint_catches_typos_and_bare_strings():
         "health.record(health.TASK_RETRIED, partition=1)\n"  # ok
         "mon.record('whatever')\n"                          # not the hook
     )
-    flagged = _bad_health_record_calls(ast.parse(bad))
-    assert [line for line, _ in flagged] == [2, 3, 4]
+    assert _seed("health-constants", bad) == [2, 3, 4]
+    flagged = lints.bad_health_record_calls(ast.parse(bad))
     assert "TASK_RETIRED" in flagged[1][1]
     # the constants set is non-trivial and holds the canonical events
-    assert "TASK_RETRIED" in _HEALTH_EVENT_CONSTANTS
-    assert "BREAKER_OPEN" in _HEALTH_EVENT_CONSTANTS
+    assert "TASK_RETRIED" in lints.HEALTH_EVENT_CONSTANTS
+    assert "BREAKER_OPEN" in lints.HEALTH_EVENT_CONSTANTS
 
 
 # ---------------------------------------------------------------------------
-# SLO metric-name lint (ISSUE 7): every SLORule constructed in core/slo.py
-# must name a DECLARED metric — an entry in
-# core.telemetry.CANONICAL_METRIC_NAMES or a `sparkdl.health.<event>`
-# mirror of a constant declared in core/health.py. A typo'd metric would
-# watch nothing forever; SLORule.__post_init__ enforces the same at
-# runtime, but this lint catches it before any scope ever runs (and on
-# rules built from concatenated module constants, where a typo'd constant
-# name would otherwise only surface at import time).
+# slo-metrics (ISSUE 7)
 # ---------------------------------------------------------------------------
-
-#: Declared health-event VALUES (the strings the mirrors are named after).
-_HEALTH_EVENT_VALUES = {
-    getattr(_health, name) for name in _HEALTH_EVENT_CONSTANTS
-}
-
-_SLO_CONST_MODULES = ("telemetry", "health", "profiling", "slo")
-_UNRESOLVED = object()  # a module-constant reference that doesn't resolve
-
-
-def _resolve_string_expr(node):
-    """Static string value of an expression: literals, telemetry./
-    health./profiling. module constants (bare names resolve too, for
-    constants referenced inside their own module), and `+`
-    concatenations of those. ``_UNRESOLVED`` for a module-constant
-    reference that does not exist (a typo'd constant); None when the
-    expression is genuinely dynamic (a local variable)."""
-    if isinstance(node, ast.Constant) and isinstance(node.value, str):
-        return node.value
-    attr = None
-    flag_missing = False
-    if isinstance(node, ast.Attribute):
-        attr = node.attr
-        flag_missing = (isinstance(node.value, ast.Name)
-                        and node.value.id in _SLO_CONST_MODULES)
-    elif isinstance(node, ast.Name):
-        attr = node.id
-    if attr is not None:
-        for mod in (_telemetry, _health, _profiling):
-            value = getattr(mod, attr, None)
-            if isinstance(value, str):
-                return value
-        return _UNRESOLVED if flag_missing else None
-    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
-        left = _resolve_string_expr(node.left)
-        right = _resolve_string_expr(node.right)
-        if left is _UNRESOLVED or right is _UNRESOLVED:
-            return _UNRESOLVED
-        if left is not None and right is not None:
-            return left + right
-    return None
-
-
-def _bad_slo_rule_metrics(tree: ast.AST):
-    """(lineno, reason) for every `SLORule(...)` whose metric argument
-    does not statically resolve to a declared metric name."""
-    out = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        f = node.func
-        fname = (f.id if isinstance(f, ast.Name)
-                 else f.attr if isinstance(f, ast.Attribute) else None)
-        if fname != "SLORule":
-            continue
-        metric_arg = None
-        for kw in node.keywords:
-            if kw.arg == "metric":
-                metric_arg = kw.value
-        if metric_arg is None and len(node.args) >= 2:
-            metric_arg = node.args[1]
-        if metric_arg is None:
-            out.append((node.lineno, "no metric argument"))
-            continue
-        metric = _resolve_string_expr(metric_arg)
-        if metric is _UNRESOLVED:
-            out.append((node.lineno,
-                        "metric references an undeclared module constant"))
-            continue
-        if metric is None:
-            continue  # dynamic: SLORule's runtime validation covers it
-        if metric in _telemetry.CANONICAL_METRIC_NAMES:
-            continue
-        prefix = _telemetry.HEALTH_METRIC_PREFIX
-        if (metric.startswith(prefix)
-                and metric[len(prefix):] in _HEALTH_EVENT_VALUES):
-            continue
-        out.append((node.lineno, f"undeclared metric {metric!r}"))
-    return out
 
 
 def test_every_slo_rule_metric_is_declared():
-    path = ROOT / "core" / "slo.py"
-    tree = ast.parse(path.read_text(), filename=str(path))
-    # the lint is not vacuous: slo.py really constructs rules
+    # the rule is not vacuous: slo.py really constructs rules
+    slo_tree = ast.parse((ROOT / "core" / "slo.py").read_text())
     assert any(isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
-               and n.func.id == "SLORule" for n in ast.walk(tree))
-    offenders = [f"core/slo.py:{line}: {reason}"
-                 for line, reason in _bad_slo_rule_metrics(tree)]
+               and n.func.id == "SLORule" for n in ast.walk(slo_tree))
+    offenders = _package_findings("slo-metrics")
     assert not offenders, (
         "SLO rule metric not declared in core.telemetry."
         "CANONICAL_METRIC_NAMES (or as a sparkdl.health.<event> mirror "
-        "of a core/health.py constant) — a typo'd metric watches nothing "
-        f"forever. Fix the name or declare the metric: {offenders}")
+        "of a core/health.py constant) — a typo'd metric watches "
+        f"nothing forever: {[str(f) for f in offenders]}")
 
 
 def test_slo_metric_lint_catches_typos_and_resolves_constants():
@@ -581,12 +260,13 @@ def test_slo_metric_lint_catches_typos_and_resolves_constants():
         "SLORule('f', 'sparkdl.health.not_an_event',\n"            # bad
         "        1.0, 1.0)\n"                                      # mirror
     )
-    flagged = _bad_slo_rule_metrics(ast.parse(bad))
-    assert [line for line, _ in flagged] == [3, 10, 15]
+    assert _seed("slo-metrics", bad) == [3, 10, 15]
+    flagged = lints.bad_slo_rule_metrics(ast.parse(bad))
     assert "queue_wait_ss" in flagged[0][1]
     assert "undeclared module constant" in flagged[1][1]
     assert "not_an_event" in flagged[2][1]
     # the shipped default rules resolve through exactly these paths
     assert "sparkdl.health.executor_shed" not in \
         _telemetry.CANONICAL_METRIC_NAMES
-    assert "executor_shed" in _HEALTH_EVENT_VALUES
+    assert "executor_shed" in {
+        getattr(_health, name) for name in lints.HEALTH_EVENT_CONSTANTS}
