@@ -33,6 +33,12 @@ by hand; these rules make that vigilance a tool:
   ``name=`` (anonymous ``Thread-N`` names make every stack dump and
   telemetry track unreadable) and live in a module with a reachable
   ``join`` path (a thread nobody can join is a leak by construction).
+  ``multiprocessing.Process(…)`` (any spelling: ``multiprocessing`` /
+  ``mp`` / a ``get_context(...)`` variable / bare ``Process``) is held
+  to the same bar — ``name=`` required, plus ``daemon=True`` or a
+  module join path: a leaked worker PROCESS outlives the interpreter
+  unless it is daemonic or someone reaps it (the decode pool names and
+  joins its workers; this rule is how it polices itself).
 
 All static, all conservative: resolution failures drop edges rather
 than inventing them (see :mod:`sparkdl_tpu.analysis.locks` for exactly
@@ -291,13 +297,16 @@ class UnguardedSharedWriteRule(Rule):
 @register
 class ThreadLifecycleRule(Rule):
     id = "thread-lifecycle"
-    title = "threads must be named and joinable"
+    title = "threads and worker processes must be named and reapable"
     rationale = (
-        "An anonymous Thread-N makes every stack dump, log line and "
-        "telemetry track unreadable; a thread created in a module with "
-        "no join path anywhere is a leak by construction (the "
-        "prefetcher, coalescer and exporter all pair creation with a "
-        "close()/shutdown() join).")
+        "An anonymous Thread-N (or Process-N) makes every stack dump, "
+        "log line and telemetry track unreadable; a thread created in "
+        "a module with no join path anywhere is a leak by construction "
+        "(the prefetcher, coalescer and exporter all pair creation "
+        "with a close()/shutdown() join). A multiprocessing.Process is "
+        "worse: a leaked non-daemon worker outlives the interpreter — "
+        "it needs name= plus daemon=True or a module join path (the "
+        "decode pool does both).")
 
     def check(self, src: SourceFile) -> List[Finding]:
         model = locks.module_model(src)
@@ -315,4 +324,19 @@ class ThreadLifecycleRule(Rule):
                     "threading.Thread(...) in a module with no "
                     ".join(...) call anywhere — every started thread "
                     "needs a reachable join/stop path"))
+        for line, has_name, daemonic in model.processes:
+            if not has_name:
+                findings.append(self.finding(
+                    src, line,
+                    "multiprocessing.Process(...) without name= — name "
+                    "the worker (sparkdl-<role>) so ps output, stack "
+                    "dumps and telemetry stay readable"))
+            if not daemonic and not model.has_join:
+                findings.append(self.finding(
+                    src, line,
+                    "multiprocessing.Process(...) that is neither "
+                    "daemon=True nor in a module with a .join(...) "
+                    "call anywhere — a leaked non-daemon worker "
+                    "process outlives the interpreter; daemonize it or "
+                    "give the module a reachable join/reap path"))
         return findings

@@ -106,6 +106,13 @@ class ModuleLockModel:
     functions: Dict[str, FunctionSummary] = field(default_factory=dict)
     threads: List[Tuple[int, bool]] = field(default_factory=list)
     # (lineno, has_name) per threading.Thread(...) creation
+    processes: List[Tuple[int, bool, bool]] = field(default_factory=list)
+    # (lineno, has_name, daemon=True) per multiprocessing Process(...)
+    # creation (multiprocessing.Process / mp.Process / <get_context
+    # var>.Process / bare Process)
+    ctx_names: Set[str] = field(default_factory=set)
+    # module globals assigned from multiprocessing.get_context(...) —
+    # their .Process(...) calls are process factories
     has_join: bool = False
 
     def summary(self, key: Tuple[str, str]) -> Optional[FunctionSummary]:
@@ -142,6 +149,38 @@ def _is_thread_factory(call: ast.Call) -> bool:
         return (f.attr == "Thread" and isinstance(f.value, ast.Name)
                 and f.value.id == "threading")
     return isinstance(f, ast.Name) and f.id == "Thread"
+
+
+# Receiver names whose ``.Process(...)`` is a worker-process factory.
+# Deliberately narrow: an arbitrary ``X.Process(pid)`` (psutil's process
+# HANDLE lookup, say) creates nothing, so only the multiprocessing
+# module spellings and get_context(...) results count.
+_PROCESS_BASES = {"multiprocessing", "mp"}
+
+
+def _is_get_context(value: ast.expr) -> bool:
+    """``multiprocessing.get_context(...)`` / ``get_context(...)``."""
+    if not isinstance(value, ast.Call):
+        return False
+    f = value.func
+    name = (f.attr if isinstance(f, ast.Attribute)
+            else f.id if isinstance(f, ast.Name) else None)
+    return name == "get_context"
+
+
+def _is_process_factory(call: ast.Call, ctx_names: Set[str]) -> bool:
+    """``multiprocessing.Process(...)`` in any of its spellings:
+    ``multiprocessing``/``mp`` attribute access, a variable bound from
+    ``get_context(...)`` (module global or local), or a bare imported
+    ``Process``."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id == "Process"
+    if isinstance(f, ast.Attribute) and f.attr == "Process":
+        v = f.value
+        if isinstance(v, ast.Name):
+            return v.id in _PROCESS_BASES or v.id in ctx_names
+    return False
 
 
 def _nonblocking_acquire(call: ast.Call) -> bool:
@@ -233,6 +272,10 @@ class _ModuleScanner:
                         if isinstance(t, ast.Name):
                             self.model.module_locks[t.id] = Lock(
                                 f"{self.model.rel}:{t.id}", kind)
+                elif _is_get_context(node.value):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self.model.ctx_names.add(t.id)
         # EVERY class in the module gets its own inventory — including
         # classes nested in methods (the fitMultiple iterator idiom):
         # their self.<attr> locks belong to THEM, not the enclosing
@@ -335,6 +378,9 @@ class _ModuleScanner:
                     cls: Optional[ClassModel],
                     annotations: Dict[str, str]) -> FunctionSummary:
         s = FunctionSummary(qualname=qual, lineno=lineno)
+        # get_context(...) results bound to locals inside this body:
+        # their .Process(...) calls are process factories too
+        ctx_locals: Set[str] = set()
 
         def handle_call(node: ast.Call,
                         held: Tuple[HeldLock, ...]) -> None:
@@ -342,6 +388,15 @@ class _ModuleScanner:
             if _is_thread_factory(node):
                 has_name = any(kw.arg == "name" for kw in node.keywords)
                 self.model.threads.append((node.lineno, has_name))
+            elif _is_process_factory(node,
+                                     self.model.ctx_names | ctx_locals):
+                has_name = any(kw.arg == "name" for kw in node.keywords)
+                daemonic = any(
+                    kw.arg == "daemon"
+                    and isinstance(kw.value, ast.Constant)
+                    and kw.value.value is True for kw in node.keywords)
+                self.model.processes.append(
+                    (node.lineno, has_name, daemonic))
             if is_thread_join(node):
                 self.model.has_join = True
             if isinstance(f, ast.Attribute):
@@ -412,6 +467,9 @@ class _ModuleScanner:
             if isinstance(node, ast.Call):
                 handle_call(node, held)
             elif isinstance(node, ast.Assign):
+                if _is_get_context(node.value):
+                    ctx_locals.update(t.id for t in node.targets
+                                      if isinstance(t, ast.Name))
                 record_write_targets(node.targets, node.lineno, held)
             elif isinstance(node, ast.AugAssign):
                 record_write_targets([node.target], node.lineno, held)

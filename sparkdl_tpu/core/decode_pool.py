@@ -1,0 +1,671 @@
+"""Multi-process host decode pool (ISSUE 9 tentpole).
+
+The device featurizes 9.5k-23.6k images/s/chip but every e2e
+files→decode→featurize bench sat at ~94 images/s with ``sparkdl.decode``
+the dominant host phase (BENCH_r05): JPEG decode on the PIL fallback is
+CPU- and GIL-bound Python, so the engine's partition *threads* cannot
+parallelize it. This module is the tf.data/DALI-style parallel-ingest
+stage rebuilt host-side: ``EngineConfig.decode_workers`` spawn-context
+worker processes fan the image blobs of a partition out, decode to HWC
+uint8, and hand the pixels back through POSIX shared memory — the
+multi-MB decoded arrays never travel through a pickle pipe; only the
+(small) compressed blobs go out and (tiny) shape metadata comes back.
+
+Design points:
+
+- **Spawn, never fork**: the parent owns a live JAX/PJRT runtime; a
+  forked child inheriting device handles is undefined behavior. Workers
+  are ``multiprocessing.get_context("spawn")`` processes that import only
+  the image codec stack (``sparkdl_tpu.core`` is lazy — no jax import,
+  ~0.2 s startup per worker, no device footprint).
+- **Order-preserving**: a :meth:`DecodePool.decode` call slices its blob
+  list into contiguous chunks, fans the chunks out, and reassembles
+  results by slice position — per-blob decode-time variance reorders
+  nothing.
+- **Bit-identical**: workers run the exact inline decoder
+  (``imageIO.decodePoolChunk`` — the same ONE native threaded batch
+  call per fixed-geometry chunk, the same PIL fallback), fault
+  injection + health accounting stay in the SUBMITTING process, and an
+  exception the inline path would raise (an unsupported channel count)
+  ships back typed and re-raises at the submitting call site instead of
+  degrading to null rows — pool on/off produces identical rows,
+  identical ``decode_degraded`` events, and identical failures.
+  ``decode_workers=0`` (default) never touches this module.
+- **Crash-tolerant**: a worker process dying (including the armed
+  ``decode_pool_worker_crash`` injection point, which makes the worker
+  ``os._exit(1)`` mid-task) is detected by the waiters' poll, the worker
+  is respawned (one ``decode_pool_respawn`` health event per death), and
+  every possibly-lost chunk is resubmitted; a chunk that dies
+  :data:`_MAX_ATTEMPTS` times fails with
+  :class:`~sparkdl_tpu.core.resilience.DecodeWorkerLost` — classified
+  RETRYABLE, so the engine's supervised task retry replays the partition.
+- **Bounded**: at most ``EngineConfig.decode_pool_inflight`` chunks
+  (default ``2 × workers``) are in flight pool-wide — host memory for
+  decoded-but-unconsumed pixels stays O(inflight × chunk), and a fast
+  submitter backpressures instead of ballooning the task queue.
+- **Clean shutdown**: :meth:`DecodePool.close` (ctx-manager /
+  ``__del__`` safety net) poisons and joins every worker, drains every
+  result pipe to EOF so every orphaned shared-memory segment is
+  unlinked, stops the collector thread, and fails mid-stream waiters —
+  no leaked process, no leaked segment.
+- **Observable**: a ``sparkdl.decode_pool`` span per decode call (parents
+  under the calling partition task's trace), a pool queue-depth gauge, a
+  workers-busy gauge, and a per-blob decode-latency histogram
+  (chunk-amortized, measured in the worker, shipped with the result
+  metadata).
+
+Result transport: each worker owns a PRIVATE result pipe (single
+writer — no shared result-queue lock a process killed mid-delivery
+could die holding; the parent sees the death as EOF) multiplexed by
+one collector thread via ``multiprocessing.connection.wait``; a reaped
+worker's pipe is retained until drained to EOF so buffered results
+(and their shared-memory segments) are never dropped unadopted.
+
+Shared-memory lifecycle: the WORKER creates one segment per chunk,
+packs the decoded arrays back-to-back, unregisters the segment from its
+own ``resource_tracker`` (ownership transfers with the message) and
+closes its mapping; the parent's collector thread attaches, copies each
+array out (the one copy the batch-stacking consumer needs anyway), then
+closes **and unlinks**. A result arriving for an already-resolved or
+abandoned chunk (crash resubmission races, close mid-stream) is adopted
+the same way before being dropped, so segments cannot leak whichever
+side wins a race.
+
+Docs: docs/PERF.md "Parallel host ingest"; metric catalog rows in
+docs/OBSERVABILITY.md.
+"""
+
+from __future__ import annotations
+
+import atexit
+import itertools
+import logging
+import multiprocessing as mp
+import os
+import threading
+import time
+from multiprocessing import resource_tracker, shared_memory
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from sparkdl_tpu.core import health, resilience, telemetry
+
+logger = logging.getLogger(__name__)
+
+# One spawn context for every pool (module-level so the thread-lifecycle
+# analyzer rule can resolve `_MP_CTX.Process(...)` as a process factory).
+_MP_CTX = mp.get_context("spawn")
+
+# Waiter/submitter poll granularity: bounds worker-crash detection
+# latency without a dedicated monitor thread.
+_WAIT_POLL_S = 0.05
+# Blobs per worker task: small enough that unequal per-blob decode times
+# balance across workers, large enough to amortize the queue round trip.
+_MAX_CHUNK = 32
+# Total tries per chunk across worker deaths before the chunk fails with
+# a (RETRYABLE) DecodeWorkerLost.
+_MAX_ATTEMPTS = 3
+
+# True inside a spawned worker (set by _worker_main): a worker must never
+# route its own decodes back into a pool (and EngineConfig in the fresh
+# interpreter defaults to decode_workers=0 anyway — belt and braces).
+_IN_WORKER = False
+
+
+def _pack_result(arrays: Sequence[Optional[np.ndarray]],
+                 decode_s: Sequence[float]) -> Dict[str, Any]:
+    """Worker-side: pack decoded HWC uint8 arrays into ONE shared-memory
+    segment; the queue message carries only names/shapes/offsets."""
+    meta: Dict[str, Any] = {
+        "shapes": [None if a is None else tuple(a.shape) for a in arrays],
+        "offsets": [None] * len(arrays),
+        "decode_s": list(decode_s),
+        "shm": None,
+    }
+    total = sum(a.nbytes for a in arrays if a is not None)
+    if not total:
+        return meta
+    seg = shared_memory.SharedMemory(create=True, size=total)
+    try:
+        off = 0
+        for i, a in enumerate(arrays):
+            if a is None:
+                continue
+            a = np.ascontiguousarray(a, dtype=np.uint8)
+            dst = np.ndarray(a.shape, dtype=np.uint8, buffer=seg.buf,
+                             offset=off)
+            np.copyto(dst, a)
+            meta["offsets"][i] = off
+            off += a.nbytes
+        meta["shm"] = seg.name
+    finally:
+        try:
+            # ownership transfers to the parent with the result message:
+            # without this, the worker's resource_tracker would warn (or
+            # double-unlink) at worker exit for a segment the parent owns
+            resource_tracker.unregister(seg._name, "shared_memory")
+        except Exception:  # pragma: no cover - tracker API drift
+            pass
+        seg.close()
+    return meta
+
+
+def _adopt_result(meta: Dict[str, Any]) -> List[Optional[np.ndarray]]:
+    """Parent-side: attach the chunk's segment, copy every array out,
+    then close AND unlink — the segment's life ends here regardless of
+    whether a waiter still wants the arrays."""
+    shapes = meta["shapes"]
+    arrays: List[Optional[np.ndarray]] = [None] * len(shapes)
+    name = meta.get("shm")
+    if name is None:
+        return arrays
+    seg = shared_memory.SharedMemory(name=name)
+    try:
+        for i, shape in enumerate(shapes):
+            if shape is None:
+                continue
+            view = np.ndarray(shape, dtype=np.uint8, buffer=seg.buf,
+                              offset=meta["offsets"][i])
+            arrays[i] = np.array(view, copy=True)
+    finally:
+        seg.close()
+        try:
+            seg.unlink()
+        except FileNotFoundError:  # pragma: no cover - double-free race
+            pass
+    return arrays
+
+
+def _worker_main(tasks: Any, conn: Any) -> None:
+    """Worker process loop: decode chunks until the ``None`` poison pill.
+
+    Runs in a fresh spawn interpreter: ``sparkdl_tpu.core`` is lazy, so
+    the import below pulls only numpy/pyarrow/PIL and the native loader
+    — never jax. Undecodable blobs degrade per row inside
+    ``decodePoolChunk`` (``None`` rows); exceptions the INLINE decoder
+    would raise (bad channel counts) ship back as a typed chunk error
+    and re-raise in the submitting process — pool on/off fail
+    identically. Results travel over this worker's PRIVATE ``conn``
+    (one writer per pipe — no shared queue lock a dying worker could
+    wedge); only the armed ``decode_pool_worker_crash`` marker kills
+    the process.
+    """
+    global _IN_WORKER
+    _IN_WORKER = True
+    from sparkdl_tpu.image import imageIO  # one heavy import per worker
+
+    while True:
+        task = tasks.get()
+        if task is None:
+            conn.close()
+            return
+        task_id, blobs, target_size, channels, crash = task
+        if crash:
+            os._exit(1)  # injected worker crash: die without cleanup
+        t0 = time.perf_counter()
+        try:
+            arrays = imageIO.decodePoolChunk(
+                blobs, target_size=target_size, channels=channels)
+        # sparkdl: allow(broad-retry): not a retry — the error ships to the submitting process and re-raises there with inline-path semantics
+        except Exception as e:  # noqa: BLE001 - re-raised parent-side
+            conn.send((task_id, {"error": (type(e).__name__, str(e))}))
+            continue
+        per_blob = (time.perf_counter() - t0) / max(1, len(blobs))
+        conn.send((task_id,
+                   _pack_result(arrays, [per_blob] * len(blobs))))
+
+
+class _Chunk:
+    """One fan-out unit: a contiguous slice of a decode call's blobs,
+    plus everything needed to resubmit it after a worker crash."""
+
+    __slots__ = ("blobs", "target_size", "channels", "event", "result",
+                 "error", "attempts")
+
+    def __init__(self, blobs: List[Optional[bytes]], target_size,
+                 channels) -> None:
+        self.blobs = blobs
+        self.target_size = target_size
+        self.channels = channels
+        self.event = threading.Event()
+        self.result: Optional[List[Optional[np.ndarray]]] = None
+        self.error: Optional[BaseException] = None
+        self.attempts = 1
+
+
+def _rebuild_error(type_name: str, msg: str) -> BaseException:
+    """Reconstruct a worker-side exception in the parent, preserving the
+    builtin type so ``resilience.classify`` sees what the inline path
+    would have raised (a ValueError stays FATAL across the process
+    boundary)."""
+    import builtins
+
+    etype = getattr(builtins, type_name, None)
+    if isinstance(etype, type) and issubclass(etype, Exception):
+        try:
+            return etype(msg)
+        except Exception:  # pragma: no cover - exotic ctor signature
+            pass
+    return RuntimeError(f"{type_name}: {msg}")
+
+
+class _Worker:
+    """One worker process plus its PRIVATE task queue, its PRIVATE
+    result pipe, and the ids of the chunks dispatched to it.
+
+    Private channels per worker (instead of shared queues) buy three
+    guarantees: a crashed worker's in-queue tasks die WITH it (they are
+    precisely re-dispatched from ``assigned`` — no blanket
+    resubmission, no stale tasks outliving the crash); a process killed
+    while blocked in ``Queue.get`` — which holds the queue's reader
+    lock — wedges only its own abandoned queue, never its siblings';
+    and a process killed MID-RESULT-DELIVERY corrupts only its own pipe
+    (each pipe has exactly one writer, the worker's main thread — there
+    is no shared result-queue write lock to die holding), which the
+    collector sees as EOF and the reaper turns into a respawn."""
+
+    __slots__ = ("proc", "queue", "conn", "assigned")
+
+    def __init__(self, proc: Any, queue: Any, conn: Any) -> None:
+        self.proc = proc
+        self.queue = queue
+        self.conn = conn  # parent's read end; None once EOF-drained
+        self.assigned: set = set()
+
+
+class DecodePool:
+    """N spawn-context decode worker processes, each with a PRIVATE task
+    queue in and a PRIVATE result pipe back (multiplexed by one
+    collector thread — see the module docstring's crash-safety
+    rationale; there is no shared channel a dying worker can wedge).
+
+    ::
+
+        with DecodePool(workers=8) as pool:
+            arrays = pool.decode(blobs, target_size=(224, 224), channels=3)
+
+    ``decode`` is thread-safe: concurrent partition tasks share the pool
+    (and the ``decode_pool_inflight`` backpressure bound). Callers
+    normally never construct one — :func:`maybe_pool` manages the
+    process-wide instance from ``EngineConfig.decode_workers``.
+    """
+
+    def __init__(self, workers: int,
+                 inflight: Optional[int] = None) -> None:
+        if workers < 1:
+            raise ValueError(f"decode pool needs >= 1 worker, got {workers}")
+        self.workers = int(workers)
+        self.inflight = int(inflight) if inflight else 2 * self.workers
+        if self.inflight < 1:
+            raise ValueError(
+                f"decode_pool_inflight must be >= 1, got {inflight!r}")
+        self._lock = threading.Lock()
+        self._pending: Dict[int, _Chunk] = {}
+        self._ids = itertools.count(1)
+        self._sem = threading.BoundedSemaphore(self.inflight)
+        self._closed = False
+        self.respawns = 0  # worker deaths survived (tests/debugging)
+        # parent-internal wakeup pipe: nudges the collector out of its
+        # connection.wait when the conn list changes (respawn) or the
+        # pool closes
+        self._wake_r, self._wake_w = _MP_CTX.Pipe(duplex=False)
+        # conns of reaped (replaced) workers, kept until the collector
+        # drains them to EOF: a dead worker may have delivered results
+        # — with live shared-memory names — that are still buffered in
+        # its pipe, and dropping the conn would leak the segments
+        self._retired_conns: List[Any] = []
+        # incremental append (not a comprehension): a spawn failing at
+        # worker k must leave workers 0..k-1 reachable so the cleanup
+        # below can poison/join them instead of leaking live processes
+        self._workers: List[_Worker] = []
+        try:
+            for i in range(self.workers):
+                self._workers.append(self._spawn(i))
+        except BaseException:
+            for worker in self._workers:
+                worker.queue.put(None)
+                worker.proc.join(timeout=10.0)
+                worker.queue.cancel_join_thread()
+                worker.queue.close()
+                worker.conn.close()
+            self._wake_r.close()
+            self._wake_w.close()
+            self._closed = True
+            raise
+        self._collector = threading.Thread(
+            target=self._collect, name="sparkdl-decode-pool-collector",
+            daemon=True)
+        self._collector.start()
+
+    def _spawn(self, index: int) -> _Worker:
+        queue = _MP_CTX.Queue()
+        recv_conn, send_conn = _MP_CTX.Pipe(duplex=False)
+        proc = _MP_CTX.Process(
+            target=_worker_main, args=(queue, send_conn),
+            name=f"sparkdl-decode-{index}", daemon=True)
+        proc.start()
+        # drop the parent's copy of the write end: the worker owns the
+        # only writer, so worker death shows up as EOF on recv_conn
+        send_conn.close()
+        return _Worker(proc, queue, recv_conn)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    # -- the public decode call ----------------------------------------------
+
+    def decode(self, blobs: Sequence[Optional[bytes]],
+               target_size: Optional[Tuple[int, int]] = None,
+               channels: Optional[int] = None
+               ) -> List[Optional[np.ndarray]]:
+        """Decode ``blobs`` to HWC uint8 arrays, in submission order.
+
+        ``None``/undecodable blobs come back as ``None`` rows (the
+        tolerant contract — the caller owns health accounting). With
+        ``target_size`` and ``channels`` both set the workers run the
+        fused-resize batch decoder; otherwise each blob keeps its source
+        geometry/channels (the ``readImages`` default-decoder contract).
+        """
+        if not blobs:
+            return []
+        with telemetry.span(telemetry.SPAN_DECODE_POOL, blobs=len(blobs)):
+            per = max(1, min(_MAX_CHUNK,
+                             -(-len(blobs) // (self.workers * 2))))
+            chunks = [self._submit(list(blobs[s:s + per]), target_size,
+                                   channels)
+                      for s in range(0, len(blobs), per)]
+            out: List[Optional[np.ndarray]] = []
+            for chunk in chunks:
+                out.extend(self._await(chunk))
+            return out
+
+    # -- submission / waiting ------------------------------------------------
+
+    def _submit(self, blobs: List[Optional[bytes]], target_size,
+                channels) -> _Chunk:
+        # bounded in-flight: backpressure here, with crash detection so
+        # a dead pool cannot wedge a submitter forever
+        while not self._sem.acquire(timeout=_WAIT_POLL_S):
+            if self._closed:
+                raise resilience.DecodeWorkerLost(
+                    "decode pool closed while a submit was waiting for "
+                    "an in-flight slot")
+            self._reap_crashed()
+        chunk = _Chunk(blobs, target_size, channels)
+        with self._lock:
+            if self._closed:
+                self._sem.release()
+                raise resilience.DecodeWorkerLost(
+                    "decode pool closed before the chunk was submitted")
+            task_id = next(self._ids)
+            self._pending[task_id] = chunk
+            depth = len(self._pending)
+            self._dispatch_locked(task_id, chunk)
+        if telemetry.active() is not None:
+            telemetry.gauge_set(telemetry.M_DECODE_POOL_DEPTH, depth)
+            telemetry.gauge_set(telemetry.M_DECODE_POOL_BUSY,
+                                min(depth, self.workers))
+        return chunk
+
+    def _dispatch_locked(self, task_id: int, chunk: _Chunk) -> None:
+        """Hand a chunk to the least-loaded worker (caller holds the
+        lock). The injected ``decode_pool_worker_crash`` marker rides on
+        the task, so the chosen worker dies while holding exactly this
+        chunk — the respawn path's precise-resubmission bookkeeping is
+        what the injection exercises."""
+        worker = min(self._workers, key=lambda w: len(w.assigned))
+        worker.assigned.add(task_id)
+        crash = resilience.should_fire("decode_pool_worker_crash")
+        worker.queue.put((task_id, chunk.blobs, chunk.target_size,
+                          chunk.channels, crash))
+
+    def _await(self, chunk: _Chunk) -> List[Optional[np.ndarray]]:
+        while not chunk.event.wait(_WAIT_POLL_S):
+            self._reap_crashed()
+        if chunk.error is not None:
+            raise chunk.error
+        return chunk.result  # type: ignore[return-value]
+
+    # -- crash detection / respawn -------------------------------------------
+
+    def _reap_crashed(self) -> None:
+        """Respawn dead workers and re-dispatch exactly the chunks they
+        held.
+
+        The per-worker queues make the loss set precise: a dead worker's
+        ``assigned`` ids (intersected with still-pending chunks — it may
+        have delivered a result just before dying) are the ONLY chunks
+        re-dispatched, each with its attempt counter bumped; its queue —
+        including any not-yet-consumed tasks, which are in the loss set
+        — is abandoned with it. A chunk whose resubmission budget is
+        spent fails with a RETRYABLE DecodeWorkerLost so the engine's
+        classified task retry replays the whole partition. A duplicate
+        result (the worker delivered AND died) is adopted and dropped by
+        the collector, so shared memory never leaks whichever side wins.
+        """
+        dead: List[str] = []
+        redispatch: List[Tuple[int, _Chunk]] = []
+        failed: List[_Chunk] = []
+        with self._lock:
+            if self._closed:
+                return
+            for i, worker in enumerate(self._workers):
+                if worker.proc.is_alive():
+                    continue
+                if worker.conn is not None:
+                    # hand the dead worker's pipe to the collector: any
+                    # buffered results (and their shm segments) must
+                    # still be drained before the conn is closed
+                    self._retired_conns.append(worker.conn)
+                # abandon the dead worker's task queue WITHOUT joining
+                # its feeder thread: with >1 pipe-buffer of pickled
+                # tasks queued to a worker that will never read them,
+                # the feeder blocks in write forever, and the default
+                # Queue finalizer would join it (= hang) at exit
+                worker.queue.cancel_join_thread()
+                worker.queue.close()
+                self._workers[i] = self._spawn(i)
+                dead.append(worker.proc.name)
+                self.respawns += 1
+                for task_id in sorted(worker.assigned):
+                    chunk = self._pending.get(task_id)
+                    if chunk is None:
+                        continue  # delivered just before dying
+                    chunk.attempts += 1
+                    if chunk.attempts > _MAX_ATTEMPTS:
+                        del self._pending[task_id]
+                        failed.append(chunk)
+                    else:
+                        redispatch.append((task_id, chunk))
+            if not dead:
+                return
+            for task_id, chunk in redispatch:
+                self._dispatch_locked(task_id, chunk)
+        # the collector may be blocked in connection.wait on the OLD conn
+        # list; nudge it so the respawned workers' pipes are watched
+        self._wake_w.send_bytes(b"r")
+        for name in dead:
+            logger.warning(
+                "decode pool worker %s died; respawned (re-dispatched %d "
+                "of its chunk(s))", name, len(redispatch))
+            health.record(health.DECODE_POOL_RESPAWN, worker=name)
+        for chunk in failed:
+            chunk.error = resilience.DecodeWorkerLost(
+                f"decode pool worker died {_MAX_ATTEMPTS} times while "
+                "this chunk was in flight")
+            chunk.event.set()
+            self._sem.release()
+
+    # -- the collector thread ------------------------------------------------
+
+    def _collect(self) -> None:
+        """Multiplex every worker's private result pipe. EOF on a pipe
+        (worker exited — poison pill, crash, or killed mid-send) retires
+        that conn after its buffered results are drained; crash respawn
+        itself stays the waiters' reaper's job. Exits once the pool is
+        closed and every conn has been drained to EOF — which is exactly
+        the drain-everything guarantee the shared-memory lifecycle
+        needs."""
+        from multiprocessing import connection as _mpc
+
+        while True:
+            with self._lock:
+                conn_map = {w.conn: w for w in self._workers
+                            if w.conn is not None}
+                retired = list(self._retired_conns)
+                done = self._closed and not conn_map and not retired
+            if done:
+                return
+            for ready in _mpc.wait(list(conn_map) + retired
+                                   + [self._wake_r]):
+                if ready is self._wake_r:
+                    try:
+                        self._wake_r.recv_bytes()
+                    except (EOFError, OSError):  # pragma: no cover
+                        pass
+                    continue
+                try:
+                    task_id, meta = ready.recv()
+                except (EOFError, OSError):
+                    # worker exited; its buffered results were already
+                    # delivered in order before EOF (the waiters' poll
+                    # respawns crashed workers)
+                    ready.close()
+                    with self._lock:
+                        worker = conn_map.get(ready)
+                        if worker is not None and worker.conn is ready:
+                            worker.conn = None
+                        if ready in self._retired_conns:
+                            self._retired_conns.remove(ready)
+                    continue
+                self._resolve(task_id, meta)
+
+    def _resolve(self, task_id: int, meta: Dict[str, Any]) -> None:
+        error = meta.get("error")
+        # adopt (and free) the segment BEFORE looking the chunk up:
+        # duplicates and abandoned chunks must still unlink
+        arrays = None if error is not None else _adopt_result(meta)
+        with self._lock:
+            chunk = self._pending.pop(task_id, None)
+            depth = len(self._pending)
+            for worker in self._workers:
+                worker.assigned.discard(task_id)
+        if chunk is None:
+            return  # crash-resubmission duplicate, already resolved
+        if error is not None:
+            # what the inline decoder would have raised, re-raised at
+            # the submitting call site with its builtin type intact
+            chunk.error = _rebuild_error(*error)
+        else:
+            chunk.result = arrays
+        chunk.event.set()
+        self._sem.release()
+        if telemetry.active() is not None:
+            telemetry.gauge_set(telemetry.M_DECODE_POOL_DEPTH, depth)
+            telemetry.gauge_set(telemetry.M_DECODE_POOL_BUSY,
+                                min(depth, self.workers))
+            for dt in meta.get("decode_s", ()):
+                telemetry.observe(telemetry.M_DECODE_POOL_DECODE_S, dt)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self) -> None:
+        """Join and reap everything: workers, collector, queues, shared
+        memory. Idempotent; safe mid-stream (waiters fail with a
+        RETRYABLE DecodeWorkerLost rather than hanging)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            abandoned = list(self._pending.values())
+            self._pending.clear()
+            workers = list(self._workers)
+        for worker in workers:
+            worker.queue.put(None)  # poison pill on each private queue
+        for worker in workers:
+            worker.proc.join(timeout=10.0)
+            if worker.proc.is_alive():  # pragma: no cover - wedged worker
+                worker.proc.terminate()
+                worker.proc.join(timeout=10.0)
+            # a dead worker never consumed its pill; don't let the
+            # queue's feeder thread block interpreter exit on it
+            worker.queue.cancel_join_thread()
+            worker.queue.close()
+        # the joins above closed every worker's pipe write end, so the
+        # collector drains each conn to EOF — adopting and unlinking
+        # every remaining segment — then sees closed + no live conns and
+        # exits; the wake byte covers it being parked on an empty list
+        self._wake_w.send_bytes(b"c")
+        self._collector.join()
+        for chunk in abandoned:
+            chunk.error = resilience.DecodeWorkerLost(
+                "decode pool closed mid-stream")
+            chunk.event.set()
+            self._sem.release()
+        self._wake_w.close()
+        self._wake_r.close()
+
+    def __enter__(self) -> "DecodePool":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+    def __del__(self) -> None:  # safety net only; callers use close()/with
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 - interpreter teardown
+            pass
+
+
+# ---------------------------------------------------------------------------
+# The process-wide pool (EngineConfig.decode_workers is the ONE knob)
+# ---------------------------------------------------------------------------
+
+_pool_lock = threading.Lock()
+_pool: Optional[DecodePool] = None
+_pool_key: Optional[Tuple[int, Optional[int]]] = None
+
+
+def maybe_pool() -> Optional[DecodePool]:
+    """The process-wide pool per ``EngineConfig.decode_workers``, or
+    ``None`` when the pool is disabled (``decode_workers=0``, the
+    bit-identical inline default) or when called from inside a worker.
+    Reconfiguring the knobs closes the old pool and spawns a new one."""
+    if _IN_WORKER:
+        return None
+    from sparkdl_tpu.engine.dataframe import EngineConfig
+
+    EngineConfig.validate()
+    workers = EngineConfig.decode_workers
+    if not workers:
+        return None
+    key = (workers, EngineConfig.decode_pool_inflight)
+    global _pool, _pool_key
+    with _pool_lock:
+        stale = _pool
+        if stale is not None and _pool_key == key and not stale.closed:
+            return stale
+        _pool = None
+    if stale is not None:
+        stale.close()  # outside the lock: close() joins processes
+    with _pool_lock:
+        if _pool is None or _pool_key != key or _pool.closed:
+            _pool = DecodePool(workers,
+                               inflight=EngineConfig.decode_pool_inflight)
+            _pool_key = key
+        return _pool
+
+
+def shutdown() -> None:
+    """Close the process-wide pool (tests, bench mode flips, atexit)."""
+    global _pool
+    with _pool_lock:
+        pool, _pool = _pool, None
+    if pool is not None:
+        pool.close()
+
+
+atexit.register(shutdown)

@@ -46,6 +46,8 @@ GANG_FAILED = "gang_failed"              # runner: restart budget exhausted
 FIT_RESUMED = "fit_resumed"              # trainer: resumed from a checkpoint
 FIT_COMPLETED = "fit_completed"          # trainer: fit loop finished
 DECODE_DEGRADED = "decode_degraded"      # data plane: row degraded to null
+DECODE_POOL_RESPAWN = "decode_pool_respawn"  # decode pool: worker process
+                                         # died and was respawned
 PREFETCH_REPORT = "prefetch_report"      # pipeline: per-stream staging summary
                                          # (staged/stalls/stall_s/max_depth)
 EXECUTOR_SHED = "executor_shed"          # executor: admission shed a request

@@ -109,6 +109,17 @@ class ExecutorCircuitOpen(RuntimeError):
     exhausts without ever paying for a queue slot or a launch."""
 
 
+class DecodeWorkerLost(RuntimeError):
+    """A decode-pool worker process died (or the pool closed) while a
+    chunk was in flight and the pool's internal respawn+resubmit budget
+    could not recover it (``core/decode_pool.py``). RETRYABLE by
+    definition: worker loss is transient infrastructure failure — the
+    engine's classified task retry replays the partition, and the pool
+    has already respawned its workers by the time the retry arrives.
+    Defined here (not in core.decode_pool) so :func:`classify` stays the
+    single taxonomy source without an import cycle."""
+
+
 # Exception types whose recurrence is deterministic: retrying replays the
 # same traceback. ValueError covers shape/dtype contract violations raised
 # throughout the framework; jax shape errors are TypeError subclasses.
@@ -160,7 +171,7 @@ def classify(err: BaseException) -> str:
     if isinstance(err, DeviceOOM):
         return OOM
     if isinstance(err, (Preemption, TransferStall, ExecutorOverloaded,
-                        ExecutorCircuitOpen)):
+                        ExecutorCircuitOpen, DecodeWorkerLost)):
         return RETRYABLE
     if isinstance(err, DeadlineExceeded):
         return FATAL  # the deadline IS the retry budget; never retry past it
@@ -315,6 +326,12 @@ INJECTION_POINTS: Dict[str, Tuple[str, Optional[Callable[[], BaseException]]]] =
     "task_stall": ("behavioral: the engine partition task hangs (sleeps "
                    "past its deadline) instead of failing — exercises the "
                    "supervisor's deadline watchdog", None),
+    "decode_pool_worker_crash": (
+        "behavioral: the decode pool marks the next submitted chunk so "
+        "its worker process exits hard (os._exit) mid-task "
+        "(core/decode_pool.py) — exercises worker respawn, chunk "
+        "resubmission, and (armed persistently) the RETRYABLE "
+        "DecodeWorkerLost exhaustion path", None),
 }
 
 

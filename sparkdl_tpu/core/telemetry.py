@@ -102,12 +102,14 @@ SPAN_TASK = "sparkdl.task"                    # one pool attempt (or hedge)
 SPAN_TASK_ATTEMPT = "sparkdl.task_attempt"    # one retry-loop attempt
 SPAN_COMPILE = "sparkdl.compile"              # first launch of a new shape
 SPAN_COALESCED_LAUNCH = "sparkdl.coalesced_launch"  # core/executor.py
+SPAN_DECODE_POOL = "sparkdl.decode_pool"      # one pooled decode fan-out
+                                              # (core/decode_pool.py)
 
 CANONICAL_SPAN_NAMES = frozenset({
     SPAN_RUN, SPAN_RUNNER_ATTEMPT, SPAN_FIT, SPAN_EPOCH,
     SPAN_CHECKPOINT_SAVE, SPAN_ESTIMATOR_FIT, SPAN_COLLECT,
     SPAN_MATERIALIZE, SPAN_TASK, SPAN_TASK_ATTEMPT,
-    SPAN_COMPILE, SPAN_COALESCED_LAUNCH,
+    SPAN_COMPILE, SPAN_COALESCED_LAUNCH, SPAN_DECODE_POOL,
     # phase names (core/profiling.py constants + literal call sites)
     "sparkdl.decode", "sparkdl.stage", "sparkdl.stage_batch",
     "sparkdl.host_stage", "sparkdl.host_resize", "sparkdl.host_wait",
@@ -142,6 +144,12 @@ M_EXECUTOR_OCCUPANCY = "sparkdl.executor.occupancy"    # gauge (in-flight)
 # gauges below are the executor's own instantaneous state.
 M_EXECUTOR_QUEUE_DEPTH = "sparkdl.executor.queue_depth"  # gauge (queued reqs)
 M_EXECUTOR_SHED_RATE = "sparkdl.executor.shed_rate"    # gauge (shed fraction)
+# Parallel host decode pool (core/decode_pool.py, docs/PERF.md "Parallel
+# host ingest"):
+M_DECODE_POOL_DEPTH = "sparkdl.decode_pool.queue_depth"    # gauge (chunks)
+M_DECODE_POOL_BUSY = "sparkdl.decode_pool.workers_busy"    # gauge
+M_DECODE_POOL_DECODE_S = "sparkdl.decode_pool.decode_s"    # histogram
+                                                           # (per blob)
 HEALTH_METRIC_PREFIX = "sparkdl.health."
 
 # Instrument kind per canonical metric — machine-readable so core/slo.py
@@ -168,6 +176,9 @@ CANONICAL_METRIC_KINDS: Dict[str, str] = {
     M_EXECUTOR_OCCUPANCY: "gauge",
     M_EXECUTOR_QUEUE_DEPTH: "gauge",
     M_EXECUTOR_SHED_RATE: "gauge",
+    M_DECODE_POOL_DEPTH: "gauge",
+    M_DECODE_POOL_BUSY: "gauge",
+    M_DECODE_POOL_DECODE_S: "histogram",
 }
 
 CANONICAL_METRIC_NAMES = frozenset(CANONICAL_METRIC_KINDS)

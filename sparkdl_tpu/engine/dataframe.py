@@ -107,6 +107,17 @@ class EngineConfig:
     executor_breaker_threshold: int = 0
     executor_breaker_window_s: float = 30.0
     executor_breaker_cooldown_s: float = 1.0
+    # -- parallel host decode pool (core/decode_pool.py, docs/PERF.md
+    # "Parallel host ingest") --------------------------------------------------
+    # Spawn-context worker PROCESSES for the image-decode fan-out (JPEG
+    # decode on the PIL fallback is GIL-bound, so the partition thread
+    # pool cannot parallelize it). 0 (default) keeps today's inline
+    # decode, bit-identical; N > 0 shares one process-wide pool across
+    # every ingest path (readImages, loadImagesInternal, streaming fit).
+    decode_workers: int = 0
+    # Max in-flight decode chunks pool-wide (backpressure bound on host
+    # memory for decoded-but-unconsumed pixels); None = 2 * decode_workers.
+    decode_pool_inflight: Optional[int] = None
     max_workers: int = max(2, (os.cpu_count() or 4) // 2)
     # DEPRECATED test hook (SURVEY.md §5.3 fault injection):
     # callable(partition_index, attempt) that may raise to simulate a task
@@ -156,7 +167,8 @@ class EngineConfig:
                  cls.executor_default_priority,
                  cls.executor_breaker_threshold,
                  cls.executor_breaker_window_s,
-                 cls.executor_breaker_cooldown_s, cls.max_workers)
+                 cls.executor_breaker_cooldown_s, cls.decode_workers,
+                 cls.decode_pool_inflight, cls.max_workers)
         if knobs == cls._validated_knobs:
             return
 
@@ -212,6 +224,11 @@ class EngineConfig:
         positive("executor_breaker_window_s", cls.executor_breaker_window_s)
         positive("executor_breaker_cooldown_s",
                  cls.executor_breaker_cooldown_s, exclusive=False)
+        if cls.decode_workers < 0:
+            raise ValueError(
+                "EngineConfig.decode_workers must be >= 0 (0 disables "
+                f"the decode pool), got {cls.decode_workers!r}")
+        positive("decode_pool_inflight", cls.decode_pool_inflight)
         if cls.max_workers < 1:
             raise ValueError("EngineConfig.max_workers must be >= 1, got "
                              f"{cls.max_workers!r}")

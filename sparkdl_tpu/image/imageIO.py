@@ -25,7 +25,7 @@ from typing import Callable, Iterable, List, Optional, Sequence, Tuple
 import numpy as np
 import pyarrow as pa
 
-from sparkdl_tpu.core import health, resilience
+from sparkdl_tpu.core import health, profiling, resilience
 
 logger = logging.getLogger(__name__)
 
@@ -357,34 +357,156 @@ def decodeImageBytesBatch(blobs: Sequence[Optional[bytes]],
                           channels: int = 3) -> List[Optional[np.ndarray]]:
     """Decode a partition's worth of compressed blobs at once.
 
-    Fast path: ONE call into the threaded C++ ``sdl_decode_batch`` (the GIL
-    is released for the whole batch — SURVEY.md §7 hard-part #2, MXU
-    starvation); blobs the native decoder rejects (or all blobs, when the
-    library isn't built) fall back to PIL individually. Returns one HWC
-    uint8 array (or None) per input blob, order-preserving.
+    Fast paths, in order: the multi-process decode pool when
+    ``EngineConfig.decode_workers > 0`` (``core/decode_pool.py`` — the
+    whole list fans out to worker processes and comes back through
+    shared memory, order-preserving and pixel-identical to the inline
+    path); else ONE call into the threaded C++ ``sdl_decode_batch`` (the
+    GIL is released for the whole batch — SURVEY.md §7 hard-part #2, MXU
+    starvation); blobs the native decoder rejects (or all blobs, when
+    the library isn't built) fall back to PIL individually. Returns one
+    HWC uint8 array (or None) per input blob, order-preserving. Fault
+    injection and health accounting happen HERE, in the submitting
+    process, regardless of path — pool on/off is event-identical.
     """
-    from sparkdl_tpu.native import loader as native_loader
-
     out: List[Optional[np.ndarray]] = [None] * len(blobs)
     valid = [i for i, b in enumerate(blobs)
              if b and not _injected_decode_error()]
     if not valid:
         return out
-    res = native_loader.decode_batch_status(
-        [blobs[i] for i in valid], target_size, channels=channels)
-    if res is not None:
-        batch, ok = res
-        for j, i in enumerate(valid):
-            if ok[j]:
-                out[i] = batch[j]
-    remaining = [i for i in valid if out[i] is None]
-    for i in remaining:
-        out[i] = _pil_decode_channels(blobs[i], target_size, channels)
+    picked = [blobs[i] for i in valid]
+    pool = _maybe_decode_pool(len(picked))
+    if pool is not None:
+        decoded = pool.decode(picked, target_size=target_size,
+                              channels=channels)
+    else:
+        decoded = _decodeValidBlobs(picked, target_size, channels)
+    for j, i in enumerate(valid):
+        out[i] = decoded[j]
     undecodable = sum(1 for i in valid if out[i] is None)
     if undecodable:
         # genuinely corrupt blobs (injected fires were counted above)
         health.record(health.DECODE_DEGRADED, n=undecodable, stage="bytes")
     return out
+
+
+def _maybe_decode_pool(n_blobs: int):
+    """The process-wide decode pool, or None when disabled / not worth a
+    round trip (single-blob calls — the per-row ``decodeImageFile`` path
+    — stay inline: one IPC round trip per row would cost more than the
+    decode)."""
+    if n_blobs < 2:
+        return None
+    from sparkdl_tpu.core import decode_pool
+
+    return decode_pool.maybe_pool()
+
+
+def _decodeValidBlobs(blobs: Sequence[bytes], target_size: Tuple[int, int],
+                      channels: int) -> List[Optional[np.ndarray]]:
+    """Decode non-null blobs to fixed-geometry HWC uint8 (no fault
+    injection, no health accounting — the caller owns both). Shared by
+    the inline path and the decode-pool workers so the two can never
+    drift apart in pixel semantics.
+
+    The PIL fallback hoists the channel-mode lookup and reuses ONE
+    scratch buffer across the loop instead of allocating a fresh
+    ``BytesIO`` (and re-validating ``channels``) per failing blob.
+    """
+    from sparkdl_tpu.native import loader as native_loader
+
+    out: List[Optional[np.ndarray]] = [None] * len(blobs)
+    res = native_loader.decode_batch_status(list(blobs), target_size,
+                                            channels=channels)
+    if res is not None:
+        batch, ok = res
+        for i in range(len(blobs)):
+            if ok[i]:
+                out[i] = batch[i]
+    remaining = [i for i in range(len(blobs)) if out[i] is None]
+    if not remaining:
+        return out
+    from io import BytesIO
+
+    from PIL import Image
+
+    try:
+        mode = _PIL_MODE_BY_CHANNELS[channels]
+    except KeyError:
+        raise ValueError(
+            f"Unsupported channel count {channels}; "
+            f"supported: {sorted(_PIL_MODE_BY_CHANNELS)}") from None
+    scratch = BytesIO()
+    for i in remaining:
+        scratch.seek(0)
+        scratch.truncate()
+        scratch.write(blobs[i])
+        scratch.seek(0)
+        try:
+            img = Image.open(scratch).convert(mode)
+            if target_size is not None:
+                img = img.resize((target_size[1], target_size[0]),
+                                 Image.BILINEAR)
+            arr = np.asarray(img)
+            if arr.ndim == 2:
+                arr = arr[:, :, None]
+            out[i] = arr
+        # sparkdl: allow(broad-retry): per-blob degradation to a null row, not a retry — callers count the Nones and record decode_degraded
+        except Exception:  # noqa: BLE001 - per-blob degradation
+            out[i] = None
+    return out
+
+
+def decodePoolChunk(blobs: Sequence[Optional[bytes]],
+                    target_size: Optional[Tuple[int, int]] = None,
+                    channels: Optional[int] = None
+                    ) -> List[Optional[np.ndarray]]:
+    """One decode-pool chunk, decoded worker-side with inline-path
+    semantics. The fixed-geometry path batches the WHOLE chunk through
+    :func:`_decodeValidBlobs` — one native threaded call per chunk, not
+    one per blob, so arming the pool on a native-enabled host keeps the
+    C++ batch decoder's throughput. Errors the inline path would raise
+    (an unsupported channel count, a coercion failure) PROPAGATE — the
+    pool ships them back to the submitting process and re-raises there,
+    so pool on/off fail identically instead of degrading to null rows.
+    """
+    present = [i for i, b in enumerate(blobs) if b]
+    out: List[Optional[np.ndarray]] = [None] * len(blobs)
+    if target_size is not None and channels is not None:
+        decoded = _decodeValidBlobs([blobs[i] for i in present],
+                                    target_size, channels)
+        for j, i in enumerate(present):
+            out[i] = decoded[j]
+        return out
+    for i in present:
+        out[i] = decodePoolBlob(blobs[i], target_size=target_size,
+                                channels=channels)
+    return out
+
+
+def decodePoolBlob(blob: Optional[bytes],
+                   target_size: Optional[Tuple[int, int]] = None,
+                   channels: Optional[int] = None
+                   ) -> Optional[np.ndarray]:
+    """One blob decoded with the EXACT inline-path pixel semantics but
+    no fault injection and no health recording — the decode-pool worker
+    entry (``core/decode_pool.py``). Injection and health accounting
+    stay in the submitting process so pool on/off is event-identical.
+    """
+    if not blob:
+        return None
+    if target_size is not None and channels is not None:
+        return _decodeValidBlobs([blob], target_size, channels)[0]
+    from sparkdl_tpu.native import loader as native_loader
+
+    if native_loader.available():
+        arr = native_loader.decode(blob, target_size=target_size)
+        if arr is not None:
+            return forceChannels(arr, channels) if channels is not None \
+                else arr
+    if channels is not None:
+        return _pil_decode_channels(blob, target_size, channels)
+    return _pil_decode(blob, target_size=target_size)
 
 
 _PIL_MODE_BY_CHANNELS = {1: "L", 3: "RGB", 4: "RGBA"}
@@ -528,6 +650,51 @@ def listImageFiles(path: str) -> List[str]:
     return sorted(found)
 
 
+def _decodeBlobsDefault(blobs: Sequence[Optional[bytes]]
+                        ) -> List[Optional[np.ndarray]]:
+    """Default-decoder (:func:`decodeImageBytes`, no target size, source
+    channels preserved) over a partition's blobs: the decode pool fans
+    the list out to worker processes when armed, else the inline per-blob
+    loop. Fault injection and per-row ``decode_degraded`` accounting stay
+    in this (the submitting) process on both paths, in row order — pool
+    on/off is bit- and event-identical."""
+    out: List[Optional[np.ndarray]] = [None] * len(blobs)
+    present = [i for i, b in enumerate(blobs) if b is not None]
+    pool = _maybe_decode_pool(len(present))
+    if pool is None:
+        for i in present:
+            out[i] = decodeImageBytes(blobs[i])
+        return out
+    valid = [i for i in present if not _injected_decode_error()]
+    decoded = pool.decode([blobs[i] for i in valid])
+    for j, i in enumerate(valid):
+        out[i] = decoded[j]
+        if decoded[j] is None:
+            # mirror decodeImageBytes's per-row event exactly
+            health.record(health.DECODE_DEGRADED, stage="bytes")
+    return out
+
+
+def _readImagesDecodePartition(batch) -> pa.Array:
+    """Whole-partition decode op for the DEFAULT ``readImages`` decoder:
+    read every file, batch-decode (pool-aware), wrap as image structs."""
+    idx = batch.schema.get_field_index("filePath")
+    uris = batch.column(idx).to_pylist()
+    with profiling.annotate("sparkdl.decode", rows=len(uris)):
+        blobs: List[Optional[bytes]] = []
+        for uri in uris:
+            try:
+                with open(stripFileScheme(uri), "rb") as f:
+                    blobs.append(f.read())
+            except OSError:
+                blobs.append(None)
+        arrays = _decodeBlobsDefault(blobs)
+    values = [imageArrayToStruct(np.asarray(a), origin=u)
+              if a is not None else None
+              for a, u in zip(arrays, uris)]
+    return pa.array(values, type=imageSchema)
+
+
 def readImagesWithCustomFn(path: str, decode_f: Callable[[bytes], Optional[np.ndarray]],
                            numPartition: Optional[int] = None):
     """Read images under ``path`` with a custom decode fn → image DataFrame.
@@ -535,10 +702,28 @@ def readImagesWithCustomFn(path: str, decode_f: Callable[[bytes], Optional[np.nd
     Parity: upstream ``imageIO.readImagesWithCustomFn``. Returns an engine
     DataFrame with a single ``image`` struct column (plus ``filePath``);
     undecodable files yield null image structs, as the reference did.
+
+    The DEFAULT decoder (:func:`decodeImageBytes`) runs as a
+    whole-partition batch op so the multi-process decode pool
+    (``EngineConfig.decode_workers``, docs/PERF.md "Parallel host
+    ingest") can fan the partition's blobs out; with the pool off the op
+    degrades to the identical per-row decode loop. A custom ``decode_f``
+    keeps strict per-row semantics.
     """
     from sparkdl_tpu.engine import dataframe as edf  # lazy: avoid cycle
 
     files = listImageFiles(path)
+
+    # Only the (cheap) file listing is eager; decode runs lazily inside the
+    # engine's partition-parallel, retry-guarded column op.
+    paths_df = edf.DataFrame.fromRows(
+        [{"filePath": "file:" + f} for f in files],
+        schema=pa.schema([pa.field("filePath", pa.string())]),
+        numPartitions=numPartition)
+
+    if decode_f is decodeImageBytes:
+        return paths_df.withColumnBatch("image", _readImagesDecodePartition,
+                                        outputType=imageSchema)
 
     def load(uri: str):
         try:
@@ -551,12 +736,6 @@ def readImagesWithCustomFn(path: str, decode_f: Callable[[bytes], Optional[np.nd
             return None
         return imageArrayToStruct(np.asarray(arr), origin=uri)
 
-    # Only the (cheap) file listing is eager; decode runs lazily inside the
-    # engine's partition-parallel, retry-guarded withColumn op.
-    paths_df = edf.DataFrame.fromRows(
-        [{"filePath": "file:" + f} for f in files],
-        schema=pa.schema([pa.field("filePath", pa.string())]),
-        numPartitions=numPartition)
     return paths_df.withColumn("image", load, inputCols=["filePath"],
                                outputType=imageSchema)
 

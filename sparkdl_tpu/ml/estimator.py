@@ -290,7 +290,12 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         flow into fixed-shape train batches without materializing the
         dataset. The whole pull→decode→stage chain runs on ``Trainer.fit``'s
         prefetcher thread (ISSUE 3): partition decode for batch k+1
-        overlaps the device's training of batch k. With ``shuffle`` rows mix through a windowed shuffle
+        overlaps the device's training of batch k. With
+        ``EngineConfig.decode_workers > 0`` the partition decode itself
+        fans out to the multi-process decode pool (ISSUE 9, docs/PERF.md
+        "Parallel host ingest"), so the GIL-bound JPEG decode no longer
+        serializes on the staging thread — decode processes, staging,
+        and the device step all overlap. With ``shuffle`` rows mix through a windowed shuffle
         buffer across partitions (an EXACT global permutation requires the
         collected path, ``streaming=False``); with ``shuffle=False`` the
         batch sequence is identical to the collected path's.

@@ -20,6 +20,15 @@ chunk ``k``'s transfer+compute is in flight (``_PREFETCH_DEPTH``), and
 the engine's partition pool overlaps one partition's host decode with
 another's device work — the featurize-path adoption of the same
 ``core.pipeline.DevicePrefetcher`` the Trainer uses.
+
+Parallel host ingest (ISSUE 9): the JPEG decode feeding this
+transformer (``readImages`` / ``loadImagesInternal`` ops fused into the
+same partition task as ``apply_partition``) fans out to the
+multi-process decode pool when ``EngineConfig.decode_workers > 0``
+(``core/decode_pool.py``, docs/PERF.md "Parallel host ingest"), so the
+GIL-bound PIL fallback stops serializing the featurize pipeline:
+worker-process decode, prefetcher staging, and device compute all
+overlap, and the partition threads here only stack pixels and launch.
 """
 
 from __future__ import annotations

@@ -215,9 +215,12 @@ class CanLoadImage(Params):
         Runs host-side, partition-parallel (the reference ran it as a Spark
         Python-worker UDF; here it is an engine map over Arrow partitions).
         Default path with a known target size: the WHOLE partition decodes
-        in one call into the threaded C++ batch decoder (GIL released,
-        PIL fallback per failing image) — the hot-path fix for SURVEY.md §7
-        hard-part #2. A custom ``imageLoader`` keeps per-row semantics.
+        in one call into ``imageIO.decodeImageFilesBatch`` — the
+        multi-process decode pool when ``EngineConfig.decode_workers > 0``
+        (docs/PERF.md "Parallel host ingest"), else the threaded C++
+        batch decoder (GIL released, PIL fallback per failing image) —
+        the hot-path fix for SURVEY.md §7 hard-part #2. A custom
+        ``imageLoader`` keeps per-row semantics.
         """
         from sparkdl_tpu.core import profiling  # lazy: avoid import cycle
         from sparkdl_tpu.image import imageIO

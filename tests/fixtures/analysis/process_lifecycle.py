@@ -1,0 +1,10 @@
+"""Seeded violation: unnamed, unreapable worker process
+(thread-lifecycle, multiprocessing.Process extension)."""
+
+import multiprocessing
+
+
+def launch(fn):
+    p = multiprocessing.Process(target=fn)
+    p.start()
+    return p

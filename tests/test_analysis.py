@@ -74,6 +74,7 @@ EXPECTED_FIXTURE_RULES = {
     "blocking_under_lock.py": {"blocking-under-lock"},
     "unguarded_write.py": {"unguarded-shared-write"},
     "thread_lifecycle.py": {"thread-lifecycle"},
+    "process_lifecycle.py": {"thread-lifecycle"},
     "broad_retry.py": {"broad-retry"},
     "ml/choke_point.py": {"executor-choke-point"},
     "trainer_fetch.py": {"blocking-fetch-in-fit"},
@@ -524,6 +525,77 @@ def test_thread_lifecycle_named_and_joined_is_fine():
         "                                   name='sparkdl-worker')\n"
         "    def close(self):\n"
         "        self._t.join()\n"
+    )
+    assert not _run(source, rule_ids=["thread-lifecycle"]).findings
+
+
+def test_process_lifecycle_catches_unnamed_and_unreapable():
+    """The multiprocessing extension (ISSUE 9): an unnamed, non-daemon
+    Process in a join-free module is flagged on both counts."""
+    res = _run((FIXTURES / "process_lifecycle.py").read_text(),
+               rule_ids=["thread-lifecycle"])
+    msgs = " | ".join(f.message for f in res.findings)
+    assert "multiprocessing.Process" in msgs
+    assert "without name=" in msgs
+    assert "join" in msgs
+
+
+def test_process_lifecycle_named_daemon_via_get_context_is_fine():
+    """The decode pool's exact shape: a module-level get_context(...)
+    variable's .Process(...) with name= and daemon=True, joined in
+    close() — clean on every count."""
+    source = (
+        "import multiprocessing\n"
+        "_CTX = multiprocessing.get_context('spawn')\n"
+        "class Pool:\n"
+        "    def spawn(self, i):\n"
+        "        p = _CTX.Process(target=print, name=f'sparkdl-{i}',\n"
+        "                         daemon=True)\n"
+        "        p.start()\n"
+        "        return p\n"
+        "    def close(self, p):\n"
+        "        p.join()\n"
+    )
+    assert not _run(source, rule_ids=["thread-lifecycle"]).findings
+
+
+def test_process_lifecycle_daemon_without_join_is_fine():
+    """daemon=True satisfies the reap requirement on its own (the
+    interpreter kills daemonic workers at exit); name= is still
+    required."""
+    source = (
+        "import multiprocessing as mp\n"
+        "def launch(fn):\n"
+        "    p = mp.Process(target=fn, name='sparkdl-w', daemon=True)\n"
+        "    p.start()\n"
+        "    return p\n"
+    )
+    assert not _run(source, rule_ids=["thread-lifecycle"]).findings
+
+
+def test_process_lifecycle_local_get_context_resolves():
+    """A get_context(...) bound to a LOCAL inside the function is a
+    process factory too."""
+    source = (
+        "import multiprocessing\n"
+        "def launch(fn):\n"
+        "    ctx = multiprocessing.get_context('spawn')\n"
+        "    p = ctx.Process(target=fn)\n"
+        "    p.start()\n"
+        "    return p\n"
+    )
+    res = _run(source, rule_ids=["thread-lifecycle"])
+    msgs = " | ".join(f.message for f in res.findings)
+    assert "multiprocessing.Process" in msgs and "without name=" in msgs
+
+
+def test_process_handle_lookup_is_not_a_process_factory():
+    """psutil-style `X.Process(pid)` HANDLE lookups on arbitrary
+    receivers create nothing and must not be flagged."""
+    source = (
+        "import psutil\n"
+        "def rss(pid):\n"
+        "    return psutil.Process(pid).memory_info().rss\n"
     )
     assert not _run(source, rule_ids=["thread-lifecycle"]).findings
 

@@ -296,6 +296,56 @@ def test_chaos_coalesced_transform_matches_plain_under_faults(image_dir):
     assert health_plain[health.TASK_RETRIED] == 1
 
 
+def test_chaos_pipeline_with_decode_pool_bit_identical(image_dir, tmp_path):
+    """ISSUE 9 satellite: the FULL 5-fault chaos run with the
+    multi-process decode pool armed (EngineConfig.decode_workers=2) —
+    bit-identical outputs and the exact same health counters as the
+    pool-off run, with zero worker respawns (no crash fault armed):
+    the pool is observationally transparent, faults included."""
+    from sparkdl_tpu.core import decode_pool
+
+    x0, y0, final0, steps0 = _run_pipeline(image_dir, tmp_path / "plain")
+
+    EngineConfig.decode_workers = 2
+    inj = FaultInjector.seeded(
+        0,
+        decode_error=1,
+        engine_task=Fault(times=1, when=lambda c: (
+            c.get("phase") == "finish" and c["attempt"] == 0)),
+        device_oom=Fault(times=1, when=lambda c: c["rows"] >= 8),
+        transfer_stall=1,
+        preemption=Fault(when=lambda c: c["step"] == 3),
+    )
+    try:
+        with inj, HealthMonitor("chaos-pool") as mon:
+            x1, y1, final1, steps1 = _run_pipeline(image_dir,
+                                                   tmp_path / "chaos")
+    finally:
+        decode_pool.shutdown()
+
+    assert inj.fired == {"decode_error": 1, "engine_task": 1,
+                         "device_oom": 1, "transfer_stall": 1,
+                         "preemption": 1}
+    np.testing.assert_array_equal(x1, x0)
+    np.testing.assert_array_equal(y1, y0)
+    assert steps1 == steps0 == [1, 2, 3, 4, 5, 6]
+    for a, b in zip(jax.tree.leaves(final0.params),
+                    jax.tree.leaves(final1.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+    # the same counter set the pool-off chaos run pins — the decode
+    # fault fires in the SUBMITTING process, so pool on/off agree
+    assert mon.count(health.DECODE_DEGRADED) == 1
+    assert mon.count(health.TASK_RETRIED) == 1
+    assert mon.count(health.OOM_RECHUNK) == 1
+    assert mon.count(health.CHUNK_RETRY) == 1
+    assert mon.count(health.GANG_RESTART) == 1
+    assert mon.count(health.FIT_RESUMED) == 1
+    assert mon.count(health.FIT_COMPLETED) == 1
+    assert mon.count(health.TASK_QUARANTINED) == 0
+    assert mon.count(health.DECODE_POOL_RESPAWN) == 0
+
+
 def test_chaos_fatal_transform_error_retried_zero_times(image_dir):
     """Acceptance: FATAL errors are provably retried zero times, end to
     end — the engine task fails once, and the gang boundary (classify on
