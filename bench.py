@@ -567,6 +567,59 @@ def bench_exporter_overhead(name="EfficientNetB0", n_images=128,
     return (n_images / t_on, n_images / t_off, sp_on, sp_off, snapshots)
 
 
+def bench_durable_ingest(n_images=256):
+    """ISSUE 11 satellite: the write-ahead partition journal's cost on
+    the e2e files→readImages→featurize pipeline, durability off vs on in
+    ONE record.
+
+    The durable leg clears the journal's job dirs before every rep —
+    otherwise rep 2+ would measure journal REPLAY (zero recompute, reads
+    instead of writes) and flatter the number. Acceptance: the overhead
+    fraction stays under 5% — durability must be cheap enough to leave
+    on for any long-running job."""
+    import shutil
+
+    import jax.numpy as jnp
+
+    from sparkdl_tpu.engine.dataframe import EngineConfig
+    from sparkdl_tpu.image.imageIO import readImages
+    from sparkdl_tpu.ml import DeepImageFeaturizer
+
+    rng = np.random.default_rng(0)
+    saved = EngineConfig.snapshot()
+    results = {}
+    try:
+        with tempfile.TemporaryDirectory() as d, \
+                tempfile.TemporaryDirectory() as durable:
+            _write_jpegs(d, n_images, rng)
+            t = DeepImageFeaturizer(inputCol="image", outputCol="features",
+                                    modelName="EfficientNetB0",
+                                    batchSize=HEADLINE_BATCH,
+                                    dtype=jnp.bfloat16, weights="random")
+
+            def run():
+                if EngineConfig.durable_dir:
+                    for name in os.listdir(durable):
+                        shutil.rmtree(os.path.join(durable, name),
+                                      ignore_errors=True)
+                df = readImages(d, numPartition=4)
+                out = t.transform(df).select("features").collect()
+                assert len(out) == n_images
+
+            run()  # warmup: compile + host caches
+            for mode, root in (("durable_off", None),
+                               ("durable_on", durable)):
+                EngineConfig.durable_dir = root
+                best, spread = _best_of(run)
+                results[mode] = (n_images / best, spread)
+    finally:
+        EngineConfig.restore(saved)
+    ips_on, sp_on = results["durable_on"]
+    ips_off, sp_off = results["durable_off"]
+    return (ips_on, sp_on, ips_off, sp_off,
+            1 - ips_on / max(ips_off, 1e-9))
+
+
 def bench_batch_inference(name, n_images=256, size=(224, 224)):
     """Config 2: DeepImagePredictor over an in-memory image DataFrame."""
     import jax.numpy as jnp
@@ -846,6 +899,16 @@ def main():
                  exporter_off_spread=round(xsp_off, 4),
                  overhead_frac=round(1 - xips_on / max(xips_off, 1e-9), 4),
                  snapshots=xsnaps)
+            # durable job recovery (ISSUE 11): the write-ahead partition
+            # journal must cost < 5% on the same e2e featurize pipeline
+            (dips_on, dsp_on, dips_off, dsp_off,
+             dfrac) = bench_durable_ingest()
+            emit("durable ingest e2e images/sec (files->readImages->"
+                 "EfficientNetB0 featurize, journal on)", dips_on,
+                 "images/sec", spread=round(dsp_on, 4),
+                 durable_off=round(dips_off, 2),
+                 durable_off_spread=round(dsp_off, 4),
+                 overhead_frac=round(dfrac, 4))
 
             for name, size in (("ResNet50", (224, 224)),
                                ("Xception", (299, 299))):

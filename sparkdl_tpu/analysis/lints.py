@@ -464,3 +464,64 @@ class SLOMetricsRule(Rule):
             "CANONICAL_METRIC_NAMES entry or a sparkdl.health.<event> "
             "mirror of a core/health.py constant")
             for line, reason in bad_slo_rule_metrics(src.tree)]
+
+
+# ---------------------------------------------------------------------------
+# atomic-write (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+# Modules whose on-disk artifacts must survive kill -9: the durable
+# journal, checkpoint manifests, baseline stores, telemetry reports.
+_STATE_PERSISTING = {"durability.py", "checkpoint.py", "baseline.py",
+                     "telemetry.py"}
+
+
+def _expr_mentions_tmp(node: ast.AST) -> bool:
+    """True when the path expression visibly routes through a temp name
+    (``tmp`` in an identifier, attribute, or string literal) — the
+    write-to-tmp half of the tmp + ``os.replace`` idiom."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and "tmp" in sub.id.lower():
+            return True
+        if isinstance(sub, ast.Attribute) and "tmp" in sub.attr.lower():
+            return True
+        if (isinstance(sub, ast.Constant) and isinstance(sub.value, str)
+                and "tmp" in sub.value.lower()):
+            return True
+    return False
+
+
+@register
+class AtomicWriteRule(Rule):
+    id = "atomic-write"
+    title = "Durable state must be written tmp-then-os.replace, never in place"
+    rationale = (
+        "A crash (or injected kill -9) midway through an in-place "
+        "open(path, 'w') leaves a torn file that a restart then trusts. "
+        "State-persisting modules must write to a tmp path, fsync, and "
+        "publish with os.replace so readers only ever see complete "
+        "artifacts.")
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        if pathlib.PurePath(src.rel).name not in _STATE_PERSISTING:
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "open"
+                    and len(node.args) >= 2):
+                continue
+            mode = node.args[1]
+            if not (isinstance(mode, ast.Constant)
+                    and isinstance(mode.value, str)
+                    and "w" in mode.value):
+                continue  # reads, appends, r+b: not in-place publishes
+            if _expr_mentions_tmp(node.args[0]):
+                continue
+            out.append(self.finding(
+                src, node.lineno,
+                f"open(..., {mode.value!r}) writes durable state in "
+                "place — a crash mid-write leaves a torn file; write to "
+                "a tmp path and os.replace it over the destination"))
+        return out

@@ -82,9 +82,11 @@ import itertools
 import logging
 import multiprocessing as mp
 import os
+import re
 import threading
 import time
 from multiprocessing import resource_tracker, shared_memory
+from queue import Empty
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -107,14 +109,47 @@ _MAX_CHUNK = 32
 # a (RETRYABLE) DecodeWorkerLost.
 _MAX_ATTEMPTS = 3
 
+# Idle-worker orphan watch: a worker blocked on its task queue wakes this
+# often to check whether its owner (the submitting parent) still exists.
+# A kill -9'd parent can never deliver the poison pill, so reparenting is
+# the worker's only death signal — without it every orphaned worker
+# lingers forever.
+_ORPHAN_POLL_S = 5.0
+
+# Run-scoped shared-memory naming: sdlshm_<ownerpid>_<workerpid>_<seq>
+# (all hex). Embedding the OWNER pid in the name is what makes leaked
+# segments attributable — a kill -9'd run's in-flight segments carry a
+# dead pid, and the next pool startup sweeps them (ISSUE 11 satellite).
+_SHM_PREFIX = "sdlshm"
+_SHM_DIR = "/dev/shm"
+_SHM_NAME_RE = re.compile(
+    rf"^{_SHM_PREFIX}_([0-9a-f]+)_[0-9a-f]+_[0-9a-f]+$")
+_shm_counter = itertools.count(1)
+
 # True inside a spawned worker (set by _worker_main): a worker must never
 # route its own decodes back into a pool (and EngineConfig in the fresh
 # interpreter defaults to decode_workers=0 anyway — belt and braces).
 _IN_WORKER = False
 
 
+def _create_segment(owner_pid: int, size: int) -> shared_memory.SharedMemory:
+    """A run-scoped segment named ``sdlshm_<ownerpid>_<workerpid>_<seq>``
+    so :func:`sweep_orphaned_segments` can attribute (and reclaim) the
+    segments a kill -9'd owner left behind. A name collision (pid reuse
+    against a stale leftover) just advances the sequence number."""
+    while True:
+        name = (f"{_SHM_PREFIX}_{owner_pid:x}_{os.getpid():x}_"
+                f"{next(_shm_counter):x}")
+        try:
+            return shared_memory.SharedMemory(name=name, create=True,
+                                              size=size)
+        except FileExistsError:  # stale leftover from a reused pid
+            continue
+
+
 def _pack_result(arrays: Sequence[Optional[np.ndarray]],
-                 decode_s: Sequence[float]) -> Dict[str, Any]:
+                 decode_s: Sequence[float],
+                 owner_pid: int) -> Dict[str, Any]:
     """Worker-side: pack decoded HWC uint8 arrays into ONE shared-memory
     segment; the queue message carries only names/shapes/offsets."""
     meta: Dict[str, Any] = {
@@ -126,7 +161,7 @@ def _pack_result(arrays: Sequence[Optional[np.ndarray]],
     total = sum(a.nbytes for a in arrays if a is not None)
     if not total:
         return meta
-    seg = shared_memory.SharedMemory(create=True, size=total)
+    seg = _create_segment(owner_pid, total)
     try:
         off = 0
         for i, a in enumerate(arrays):
@@ -177,7 +212,48 @@ def _adopt_result(meta: Dict[str, Any]) -> List[Optional[np.ndarray]]:
     return arrays
 
 
-def _worker_main(tasks: Any, conn: Any) -> None:
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:  # alive, owned by someone else
+        return True
+    return True
+
+
+def sweep_orphaned_segments() -> int:
+    """Unlink decode-pool shm segments whose embedded owner pid is dead.
+
+    Normal runs adopt-and-unlink every segment (and close() drains the
+    stragglers), but a kill -9'd owner leaks its in-flight segments in
+    /dev/shm forever. Pool startup calls this; it only ever touches
+    names matching this module's ``sdlshm_`` scheme with a dead owner,
+    so concurrent runs (live owners) are untouched, and unlink races
+    between two sweepers are benign. Returns the number reclaimed.
+    """
+    try:
+        entries = os.listdir(_SHM_DIR)
+    except OSError:  # platform without /dev/shm: nothing to sweep
+        return 0
+    swept = 0
+    for entry in entries:
+        m = _SHM_NAME_RE.match(entry)
+        if m is None or _pid_alive(int(m.group(1), 16)):
+            continue
+        try:
+            os.unlink(os.path.join(_SHM_DIR, entry))
+        except OSError:  # lost the race to another sweeper
+            continue
+        swept += 1
+        logger.warning("decode pool: swept orphaned shm segment %s "
+                       "(owner dead)", entry)
+    if swept:
+        health.record(health.DECODE_POOL_SHM_SWEPT, n=swept)
+    return swept
+
+
+def _worker_main(tasks: Any, conn: Any, owner_pid: int) -> None:
     """Worker process loop: decode chunks until the ``None`` poison pill.
 
     Runs in a fresh spawn interpreter: ``sparkdl_tpu.core`` is lazy, so
@@ -190,13 +266,25 @@ def _worker_main(tasks: Any, conn: Any) -> None:
     (one writer per pipe — no shared queue lock a dying worker could
     wedge); only the armed ``decode_pool_worker_crash`` marker kills
     the process.
+
+    ``owner_pid`` is the spawning parent: it names this worker's shm
+    segments (sweepability), and an idle worker polls for its death —
+    a kill -9'd parent never sends the poison pill, so reparenting
+    (``os.getppid() != owner_pid``) is the exit signal that keeps
+    orphaned workers from living forever.
     """
     global _IN_WORKER
     _IN_WORKER = True
     from sparkdl_tpu.image import imageIO  # one heavy import per worker
 
     while True:
-        task = tasks.get()
+        try:
+            task = tasks.get(timeout=_ORPHAN_POLL_S)
+        except Empty:
+            if os.getppid() != owner_pid:  # orphaned: owner died hard
+                conn.close()
+                return
+            continue
         if task is None:
             conn.close()
             return
@@ -213,7 +301,8 @@ def _worker_main(tasks: Any, conn: Any) -> None:
             continue
         per_blob = (time.perf_counter() - t0) / max(1, len(blobs))
         conn.send((task_id,
-                   _pack_result(arrays, [per_blob] * len(blobs))))
+                   _pack_result(arrays, [per_blob] * len(blobs),
+                                owner_pid)))
 
 
 class _Chunk:
@@ -300,6 +389,9 @@ class DecodePool:
         if self.inflight < 1:
             raise ValueError(
                 f"decode_pool_inflight must be >= 1, got {inflight!r}")
+        # reclaim what a previous kill -9'd run left behind BEFORE this
+        # run starts creating its own segments
+        sweep_orphaned_segments()
         self._lock = threading.Lock()
         self._pending: Dict[int, _Chunk] = {}
         self._ids = itertools.count(1)
@@ -342,7 +434,7 @@ class DecodePool:
         queue = _MP_CTX.Queue()
         recv_conn, send_conn = _MP_CTX.Pipe(duplex=False)
         proc = _MP_CTX.Process(
-            target=_worker_main, args=(queue, send_conn),
+            target=_worker_main, args=(queue, send_conn, os.getpid()),
             name=f"sparkdl-decode-{index}", daemon=True)
         proc.start()
         # drop the parent's copy of the write end: the worker owns the

@@ -61,6 +61,20 @@ SLO_BREACH = "slo_breach"                # slo: rule held in breach past its
 SLO_RECOVERED = "slo_recovered"          # slo: breached rule back in budget
 TELEMETRY_EXPORT_ERROR = "telemetry_export_error"  # telemetry: exporter
                                          # tick crashed (skipped, not fatal)
+DURABLE_RESUMED = "durable_resumed"      # durability: a journal with
+                                         # committed partitions was resumed
+DURABLE_PARTITION_RESTORED = "durable_partition_restored"  # durability:
+                                         # committed partition loaded from
+                                         # spill instead of recomputed
+DURABLE_JOURNAL_TORN = "durable_journal_torn"  # durability: torn/corrupt
+                                         # journal record or spill hash
+                                         # mismatch discarded, not trusted
+DECODE_POOL_SHM_SWEPT = "decode_pool_shm_swept"  # decode pool: orphaned
+                                         # segment of a dead owner unlinked
+CHECKPOINT_CHECKSUM_REJECTED = "checkpoint_checksum_rejected"  # checkpoint:
+                                         # restore refused a bit-rotted file
+CHECKPOINT_FENCED = "checkpoint_fenced"  # checkpoint: stale-incarnation
+                                         # writer refused by fencing token
 
 
 class HealthMonitor:

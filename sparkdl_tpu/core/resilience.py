@@ -120,6 +120,16 @@ class DecodeWorkerLost(RuntimeError):
     single taxonomy source without an import cycle."""
 
 
+class StaleCheckpointWriter(RuntimeError):
+    """A checkpoint save was refused by the fencing token: this process
+    belongs to a superseded gang incarnation and a newer writer has
+    claimed the directory (``train/checkpoint.py``). FATAL by definition:
+    the zombie must die, not retry — every retry would be refused again,
+    and letting it through would clobber the newer incarnation's
+    checkpoints. Defined here so :func:`classify` stays the single
+    taxonomy source without an import cycle."""
+
+
 # Exception types whose recurrence is deterministic: retrying replays the
 # same traceback. ValueError covers shape/dtype contract violations raised
 # throughout the framework; jax shape errors are TypeError subclasses.
@@ -175,6 +185,8 @@ def classify(err: BaseException) -> str:
         return RETRYABLE
     if isinstance(err, DeadlineExceeded):
         return FATAL  # the deadline IS the retry budget; never retry past it
+    if isinstance(err, StaleCheckpointWriter):
+        return FATAL  # fenced-off zombie: every retry would be refused too
     msg = str(err)
     msg_lower = msg.lower()
     if any(m in msg_lower for m in _OOM_MARKERS) or _OOM_WORD.search(msg):
@@ -332,6 +344,12 @@ INJECTION_POINTS: Dict[str, Tuple[str, Optional[Callable[[], BaseException]]]] =
         "(core/decode_pool.py) — exercises worker respawn, chunk "
         "resubmission, and (armed persistently) the RETRYABLE "
         "DecodeWorkerLost exhaustion path", None),
+    "process_kill": (
+        "behavioral: the durable journal SIGKILLs its own process "
+        "immediately AFTER committing a partition record "
+        "(core/durability.py); ctx carries partition — exercises "
+        "kill -9 resume: a restarted job must load the committed "
+        "partitions from spill and recompute only the rest", None),
 }
 
 

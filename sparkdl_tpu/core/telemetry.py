@@ -1089,11 +1089,17 @@ class Telemetry:
                  window_s: Optional[float] = 60.0,
                  window_buckets: int = 12,
                  export_interval_s: Optional[float] = None,
-                 slo_rules: Optional[Sequence[Any]] = None) -> None:
+                 slo_rules: Optional[Sequence[Any]] = None,
+                 run_id: Optional[str] = None) -> None:
         self.name = name
         self.out_dir = (out_dir if out_dir is not None
                         else os.environ.get(TELEMETRY_DIR_ENV))
-        self.run_id = f"{name}-{os.getpid():x}-{next(_run_counter):04x}"
+        # run_id pins the identity across process restarts (durable
+        # recovery, core/durability.pinned_run_id): the snapshot
+        # timeline JSONL appends and the run report path stay THE SAME
+        # file before and after a crash. Default: fresh per-scope id.
+        self.run_id = run_id or (
+            f"{name}-{os.getpid():x}-{next(_run_counter):04x}")
         self.tracer = Tracer(trace_id=self.run_id, max_spans=max_spans)
         self.metrics = MetricsRegistry(window_s=window_s,
                                        window_buckets=window_buckets)
@@ -1213,14 +1219,21 @@ class Telemetry:
         os.makedirs(out_dir, exist_ok=True)
         trace_path = os.path.join(
             out_dir, f"sparkdl_trace_{self.run_id}.json")
-        with open(trace_path, "w") as f:
+        # tmp + os.replace (analyzer rule atomic-write): a crash while
+        # exporting must not leave a torn report that a durable-resume
+        # reader would trust
+        tmp = f"{trace_path}.tmp"
+        with open(tmp, "w") as f:
             json.dump(self.tracer.chrome_trace(), f)
+        os.replace(tmp, trace_path)
         report = self.report()
         report["chrome_trace"] = trace_path
         report_path = os.path.join(
             out_dir, f"sparkdl_run_report_{self.run_id}.json")
-        with open(report_path, "w") as f:
+        tmp = f"{report_path}.tmp"
+        with open(tmp, "w") as f:
             json.dump(report, f, indent=2, default=str)
+        os.replace(tmp, report_path)
         self.report_path, self.trace_path = report_path, trace_path
         return report_path
 

@@ -34,7 +34,7 @@ import numpy as np
 import pandas as pd
 import pyarrow as pa
 
-from sparkdl_tpu.core import resilience, telemetry
+from sparkdl_tpu.core import durability, resilience, telemetry
 from sparkdl_tpu.engine import supervisor as _sup
 from sparkdl_tpu.engine.supervisor import (  # noqa: F401 - re-exported API
     PartitionSupervisor,
@@ -118,6 +118,16 @@ class EngineConfig:
     # Max in-flight decode chunks pool-wide (backpressure bound on host
     # memory for decoded-but-unconsumed pixels); None = 2 * decode_workers.
     decode_pool_inflight: Optional[int] = None
+    # -- durable job recovery (core/durability.py, docs/RESILIENCE.md
+    # "Durable recovery") ------------------------------------------------------
+    # Root directory for write-ahead partition journals + atomic spills.
+    # None (default) = no durability: every path is byte- and
+    # behavior-identical to before the knob existed. Set, each
+    # materialize/streamPartitions job derives a stable job id (hash of
+    # plan + config) under this root and survives kill -9: on restart
+    # committed partitions load from verified spill, only uncommitted
+    # ones recompute, and rows re-emit in original order.
+    durable_dir: Optional[str] = None
     max_workers: int = max(2, (os.cpu_count() or 4) // 2)
     # DEPRECATED test hook (SURVEY.md §5.3 fault injection):
     # callable(partition_index, attempt) that may raise to simulate a task
@@ -168,7 +178,7 @@ class EngineConfig:
                  cls.executor_breaker_threshold,
                  cls.executor_breaker_window_s,
                  cls.executor_breaker_cooldown_s, cls.decode_workers,
-                 cls.decode_pool_inflight, cls.max_workers)
+                 cls.decode_pool_inflight, cls.durable_dir, cls.max_workers)
         if knobs == cls._validated_knobs:
             return
 
@@ -229,6 +239,11 @@ class EngineConfig:
                 "EngineConfig.decode_workers must be >= 0 (0 disables "
                 f"the decode pool), got {cls.decode_workers!r}")
         positive("decode_pool_inflight", cls.decode_pool_inflight)
+        if cls.durable_dir is not None and (
+                not isinstance(cls.durable_dir, str) or not cls.durable_dir):
+            raise ValueError(
+                "EngineConfig.durable_dir must be None or a non-empty "
+                f"directory path, got {cls.durable_dir!r}")
         if cls.max_workers < 1:
             raise ValueError("EngineConfig.max_workers must be >= 1, got "
                              f"{cls.max_workers!r}")
@@ -424,9 +439,18 @@ class DataFrame:
             # skipping only watchdog-failed tasks, whose threads may be
             # wedged on the hung op. A clean run may leave a hedge
             # loser's discarded pure ops finishing in the background.
+            ops = self._ops
+            journal = durability.maybe_journal(self._partitions,
+                                               self._schema, ops)
+            if journal is not None:
+                with telemetry.span(telemetry.SPAN_MATERIALIZE,
+                                    partitions=len(self._partitions),
+                                    ops=len(ops), durable=True):
+                    self._materialized = self._materialize_durable(journal,
+                                                                   ops)
+                return self._materialized
             sup = PartitionSupervisor(_executor(), _supervisor_config(),
                                       quarantine_probe=self._quarantine_probe)
-            ops = self._ops
             # the span is open while tasks are CREATED, so every
             # partition task's trace context parents under it
             with telemetry.span(telemetry.SPAN_MATERIALIZE,
@@ -437,6 +461,69 @@ class DataFrame:
                                                                  cancel))
                      for i, b in enumerate(self._partitions)])
             return self._materialized
+
+    def _durable_supervisor(self, journal) -> PartitionSupervisor:
+        """Supervisor whose quarantine verdicts COMMIT: a poisoned
+        partition's zero-row stand-in is journaled (quarantined=True), so
+        a restarted job honors the verdict from spill instead of
+        re-poisoning the gang."""
+        return PartitionSupervisor(
+            _executor(), _supervisor_config(),
+            quarantine_probe=lambda i: journal.commit(
+                i, self._quarantine_probe(i), quarantined=True))
+
+    def _durable_runner(self, journal, i: int, ops):
+        """A partition runner that journals: count the attempt, run the
+        op chain, spill + commit the result before handing it back."""
+        b = self._partitions[i]
+
+        def run(cancel=None, i=i, b=b):
+            journal.note_attempt(i)
+            return journal.commit(i, _run_partition(i, b, ops, cancel))
+
+        return run
+
+    def _materialize_durable(self, journal, ops) -> List[pa.RecordBatch]:
+        """Durable materialization (docs/RESILIENCE.md "Durable
+        recovery"): verified-committed partitions load from spill, only
+        uncommitted ones run through the supervisor, each committing
+        through the write-ahead journal as it completes. Output order
+        and bytes are identical to an uninterrupted run."""
+        committed = journal.resume()
+        todo = [i for i in range(len(self._partitions)) if i not in committed]
+        results: Dict[int, pa.RecordBatch] = {}
+        if todo:
+            sup = self._durable_supervisor(journal)
+            computed = sup.run_all(
+                [(i, self._durable_runner(journal, i, ops)) for i in todo])
+            results.update(zip(todo, computed))
+        for i in committed:
+            results[i] = journal.load(i)
+        return [results[i] for i in range(len(self._partitions))]
+
+    def _stream_durable(self, journal, indices: List[int], prefetch: int
+                        ) -> Iterable[pa.RecordBatch]:
+        """Durable streaming: restored partitions serve from spill,
+        uncommitted ones stream through the supervisor (same bounded
+        prefetch), interleaved back into the requested visit order."""
+        committed = journal.resume()
+        ops = self._ops
+        todo = [i for i in indices if i not in committed]
+        sup = self._durable_supervisor(journal)
+
+        def runners():
+            for i in todo:
+                yield i, self._durable_runner(journal, i, ops)
+
+        stream = sup.run_stream(runners(), prefetch=prefetch)
+        try:
+            for i in indices:
+                if i in committed:
+                    yield journal.load(i)
+                else:
+                    yield next(stream)
+        finally:
+            stream.close()
 
     def toArrow(self) -> pa.Table:
         batches = self._materialize()
@@ -522,6 +609,11 @@ class DataFrame:
             # deadlock (same guard as _materialize)
             for i in indices:
                 yield _run_partition(i, self._partitions[i], self._ops)
+            return
+        journal = durability.maybe_journal(self._partitions, self._schema,
+                                           self._ops)
+        if journal is not None:
+            yield from self._stream_durable(journal, indices, prefetch)
             return
         # Supervised bounded-prefetch streaming on the shared process-wide
         # executor (VERDICT r3 weak #6: no per-epoch pool churn). In-flight

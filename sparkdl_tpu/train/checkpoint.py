@@ -15,19 +15,43 @@ returning, so a crash mid-write can never leave a half-step that
 retained steps newest-first and falls back past corrupt/partial ones
 (bit rot, torn disks, the injected ``checkpoint_truncate`` fault) with a
 warning naming each skipped step.
+
+Crash consistency (docs/RESILIENCE.md "Durable recovery"):
+
+- **Per-file checksums**: each finalized step gets a sha256 manifest
+  (``sparkdl.sums.json`` inside the step directory, so Orbax's retention
+  deletes it with the step); ``restore`` verifies the manifest before
+  handing the bytes to Orbax, extending corruption detection from
+  truncation (which Orbax's parsers catch) to silent bit rot (which they
+  may not). Steps without a manifest (legacy, or written by another
+  tool) skip verification.
+- **Fencing token**: constructing a manager claims the next monotonic
+  gang *incarnation* for the directory (``<directory>.fence.json``).
+  Every ``save`` re-checks the token; a writer whose incarnation has
+  been superseded — a zombie from a restarted gang attempt, still
+  flushing async saves — is refused with
+  :class:`~sparkdl_tpu.core.resilience.StaleCheckpointWriter` (FATAL:
+  retrying would be refused again) instead of clobbering its
+  successor's checkpoints.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import logging
 import os
-from typing import Any, List, Optional
+from typing import Any, Dict, List, Optional
 
 import jax
 
-from sparkdl_tpu.core import resilience
+from sparkdl_tpu.core import health, resilience
 
 logger = logging.getLogger(__name__)
+
+# Checksum manifest filename, stored INSIDE the step directory (written
+# only after Orbax finalizes the step's rename-commit).
+_SUMS_NAME = "sparkdl.sums.json"
 
 
 class CheckpointManager:
@@ -50,10 +74,59 @@ class CheckpointManager:
         # final synchronous save right after the per-step save of the same
         # step) is a no-op, not an overwrite.
         self._saved_steps: set = set()
+        # Steps saved but not yet checksummed: manifests can only be
+        # computed once the (possibly async) write finalizes, so they
+        # flush at the wait_until_finished barriers.
+        self._pending_sums: set = set()
+        self._fence_path = self.directory + ".fence.json"
+        self._incarnation = self._claim_fence()
+
+    # -- fencing -------------------------------------------------------------
+
+    def _claim_fence(self) -> int:
+        """Claim the next gang incarnation of this directory.
+
+        Best-effort monotonic token (read-increment-replace): concurrent
+        claims within one host are serialized by the atomic replace, and
+        the zombie-writer scenario this fences — an old gang attempt
+        outliving the restart that superseded it — is sequential by
+        construction (the new attempt starts after the old one's crash).
+        """
+        current = 0
+        try:
+            with open(self._fence_path, encoding="utf-8") as f:
+                current = int(json.load(f)["incarnation"])
+        except (OSError, ValueError, KeyError, TypeError):
+            current = 0
+        incarnation = current + 1
+        tmp = f"{self._fence_path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"incarnation": incarnation}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._fence_path)
+        return incarnation
+
+    def _check_fence(self, step: int) -> None:
+        try:
+            with open(self._fence_path, encoding="utf-8") as f:
+                latest = int(json.load(f)["incarnation"])
+        except (OSError, ValueError, KeyError, TypeError):
+            return  # unreadable token never blocks a save
+        if latest > self._incarnation:
+            health.record(health.CHECKPOINT_FENCED, step=step,
+                          incarnation=self._incarnation, latest=latest)
+            raise resilience.StaleCheckpointWriter(
+                f"checkpoint save of step {step} refused: this writer "
+                f"holds incarnation {self._incarnation} of "
+                f"{self.directory} but incarnation {latest} has claimed "
+                "it — a superseded gang attempt must not clobber its "
+                "successor's checkpoints")
 
     def save(self, step: int, state: Any, synchronous: bool = False) -> None:
         import orbax.checkpoint as ocp
 
+        self._check_fence(step)
         if step in self._saved_steps:
             pass  # already written by this manager; nothing new to persist
         elif step in self._mgr.all_steps():
@@ -83,6 +156,7 @@ class CheckpointManager:
                     "overwriting", step, self.directory)
                 self._overwrite(step, state)
             self._saved_steps.add(step)
+        self._pending_sums.add(step)
         if synchronous:
             self._mgr.wait_until_finished()
             # Atomicity check: Orbax finalizes a step by renaming its tmp
@@ -93,6 +167,7 @@ class CheckpointManager:
                 raise IOError(
                     f"checkpoint step {step} under {self.directory} was not "
                     "committed (crash/IO failure mid-write?)")
+            self._flush_sums()
         if resilience.should_fire("checkpoint_truncate", step=step):
             # Fault injection: corrupt the just-written step in place
             # (truncate every file to half) to model bit rot / torn writes
@@ -120,6 +195,78 @@ class CheckpointManager:
             self._mgr.reload()
         self._mgr.save(step, args=ocp.args.StandardSave(state))
 
+    # -- checksums -----------------------------------------------------------
+
+    def _step_file_sums(self, step: int) -> Dict[str, str]:
+        """sha256 of every file in the step directory (manifest itself
+        excluded), keyed by step-relative path."""
+        step_dir = os.path.join(self.directory, str(step))
+        sums: Dict[str, str] = {}
+        for root, _dirs, files in os.walk(step_dir):
+            for name in sorted(files):
+                path = os.path.join(root, name)
+                rel = os.path.relpath(path, step_dir)
+                if rel == _SUMS_NAME:
+                    continue
+                h = hashlib.sha256()
+                with open(path, "rb") as f:
+                    for chunk in iter(lambda: f.read(1 << 20), b""):
+                        h.update(chunk)
+                sums[rel] = h.hexdigest()
+        return sums
+
+    def _flush_sums(self) -> None:
+        """Write the checksum manifest for every finalized pending step.
+
+        Called at the wait_until_finished barriers — the first moment
+        the step's files are final. The manifest write is itself atomic
+        (tmp + ``os.replace``): a crash mid-manifest leaves the step
+        manifest-less (verification skipped), never half-trusted.
+        """
+        live = set(self._mgr.all_steps())
+        for step in sorted(self._pending_sums):
+            self._pending_sums.discard(step)
+            if step not in live:  # retention already dropped it
+                continue
+            payload = json.dumps(
+                {"step": step, "files": self._step_file_sums(step)},
+                sort_keys=True).encode()
+            path = os.path.join(self.directory, str(step), _SUMS_NAME)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+
+    def _verify_sums(self, step: int) -> None:
+        """Refuse a restore whose bytes don't match the step's manifest.
+
+        A missing or unreadable manifest skips verification (legacy
+        steps; truncation also shreds the in-step manifest, and Orbax's
+        own parse failures catch that) — the manifest extends detection
+        to SILENT corruption, it is not a gate on old checkpoints.
+        """
+        path = os.path.join(self.directory, str(step), _SUMS_NAME)
+        try:
+            with open(path, encoding="utf-8") as f:
+                recorded = json.load(f)["files"]
+        except (OSError, ValueError, KeyError, TypeError):
+            return
+        if not isinstance(recorded, dict):
+            return
+        actual = self._step_file_sums(step)
+        mismatched = sorted(k for k in set(recorded) | set(actual)
+                            if recorded.get(k) != actual.get(k))
+        if mismatched:
+            health.record(health.CHECKPOINT_CHECKSUM_REJECTED, step=step,
+                          files=len(mismatched))
+            raise IOError(
+                f"checkpoint step {step} under {self.directory} failed "
+                f"checksum verification ({len(mismatched)} file(s), e.g. "
+                f"{mismatched[0]!r}) — refusing to restore corrupted "
+                "state")
+
     def _truncate_step(self, step: int) -> None:
         step_dir = os.path.join(self.directory, str(step))
         for root, _dirs, files in os.walk(step_dir):
@@ -146,6 +293,11 @@ class CheckpointManager:
         falls back to the previous retained step; only when every
         retained step fails does the last error propagate.
         """
+        if self._pending_sums:
+            # async saves from THIS manager not yet manifested: finalize
+            # them now so verification sees current bytes, not stale sums
+            self._mgr.wait_until_finished()
+            self._flush_sums()
         if step is not None:
             return self._restore_step(step, state_template)
         steps = sorted(self._mgr.all_steps(), reverse=True)
@@ -173,6 +325,7 @@ class CheckpointManager:
     def _restore_step(self, step: int, state_template: Any) -> Any:
         import orbax.checkpoint as ocp
 
+        self._verify_sums(step)
         template = jax.tree.map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
             if hasattr(x, "shape") and hasattr(x, "dtype") else x,
@@ -203,6 +356,7 @@ class CheckpointManager:
 
     def wait_until_finished(self) -> None:
         self._mgr.wait_until_finished()
+        self._flush_sums()
 
     def close(self) -> None:
         self._mgr.close()

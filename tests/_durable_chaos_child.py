@@ -1,0 +1,96 @@
+"""One leg of the durable-recovery kill -9 proof (tests/test_durable_chaos.py).
+
+Usage: python _durable_chaos_child.py <mode> <work_dir>
+
+All three modes build the SAME frame (18 PNGs under ``<work>/imgs``,
+6 partitions, partition 0 deterministically poisoned, decode through the
+multi-process pool) and stream it with durability on:
+
+- ``baseline``  — durable run in its own journal dir, never killed; its
+  output bytes are the bit-identity reference.
+- ``killed``    — arms the ``process_kill`` fault (SIGKILL self right
+  after the third journal commit) — the process must die mid-stream
+  with the decode pool armed and the prefetcher running.
+- ``resumed``   — same plan, same journal dir as ``killed``: must serve
+  committed partitions from spill, compute only the rest, and pin
+  telemetry to the durable run id.
+"""
+
+import glob
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def build_frame(work):
+    import numpy as np
+    import pyarrow as pa
+
+    from sparkdl_tpu.engine import DataFrame
+    from sparkdl_tpu.image import imageIO
+
+    paths = sorted(glob.glob(os.path.join(work, "imgs", "*.png")))
+    rows = [{"i": i, "blob": open(p, "rb").read()}
+            for i, p in enumerate(paths)]
+    df = DataFrame.fromRows(rows, numPartitions=6)
+
+    def decode(batch):
+        if len(batch) == 0:  # quarantine's zero-row probe
+            return pa.array([], pa.float64())
+        if batch.column("i")[0].as_py() == 0:
+            raise ValueError("poison partition")  # FATAL -> quarantine
+        blobs = [b.as_py() for b in batch.column("blob")]
+        arrs = imageIO.decodeImageBytesBatch(blobs, (8, 8))
+        return pa.array([float(np.asarray(a, dtype=np.float64).sum())
+                         for a in arrs])
+
+    return df.withColumnBatch("px", decode, outputType=pa.float64())
+
+
+def main():
+    mode, work = sys.argv[1], sys.argv[2]
+    import pyarrow as pa
+
+    from sparkdl_tpu.core import durability
+    from sparkdl_tpu.core.resilience import Fault, FaultInjector
+    from sparkdl_tpu.core.telemetry import Telemetry
+    from sparkdl_tpu.engine import EngineConfig
+
+    durable = os.path.join(
+        work, "durable-baseline" if mode == "baseline" else "durable")
+    EngineConfig.durable_dir = durable
+    EngineConfig.decode_workers = 2
+    EngineConfig.quarantine = True
+
+    df = build_frame(work)
+    out_path = os.path.join(work, f"rows_{mode}.arrow")
+
+    def run():
+        batches = list(df.streamPartitions(prefetch=2))
+        with pa.OSFile(out_path, "wb") as sink:
+            with pa.ipc.new_stream(sink, batches[0].schema) as w:
+                for b in batches:
+                    w.write_batch(b)
+
+    if mode == "baseline":
+        run()
+    elif mode == "killed":
+        run_id = durability.pinned_run_id(durable)
+        with Telemetry("chaos", out_dir=os.path.join(work, "tel"),
+                       export_interval_s=0.05, run_id=run_id):
+            with FaultInjector.seeded(0, process_kill=Fault(after=2)):
+                run()
+        raise SystemExit("killed leg survived: process_kill never fired")
+    elif mode == "resumed":
+        run_id = durability.pinned_run_id(durable)
+        with Telemetry("chaos", out_dir=os.path.join(work, "tel"),
+                       export_interval_s=0.05, run_id=run_id):
+            run()
+    else:
+        raise SystemExit(f"unknown mode {mode!r}")
+
+
+if __name__ == "__main__":
+    main()

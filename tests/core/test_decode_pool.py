@@ -288,3 +288,68 @@ def test_read_images_pool_parity_and_telemetry(tmp_path):
     # the span parents under the partition task that submitted it
     ids = {s["span_id"] for s in tel.tracer.spans()}
     assert all(s["parent_id"] in ids for s in spans)
+
+
+def test_sweep_reclaims_dead_owner_segments_only():
+    """A kill -9'd owner's run-scoped segments (name embeds the owner
+    pid) are reclaimed by the next pool's startup sweep; a live owner's
+    segments are untouched."""
+    import subprocess
+    import sys
+    from multiprocessing import resource_tracker, shared_memory
+
+    if not os.path.isdir(decode_pool._SHM_DIR):
+        pytest.skip("no /dev/shm on this platform")
+    # a pid that is certainly dead: a just-reaped child
+    proc = subprocess.Popen([sys.executable, "-c", "pass"])
+    proc.wait()
+    dead_pid = proc.pid
+
+    def make(owner_pid, seq):
+        seg = shared_memory.SharedMemory(
+            name=f"{decode_pool._SHM_PREFIX}_{owner_pid:x}_{owner_pid:x}"
+                 f"_{seq:x}", create=True, size=64)
+        # the test plays the worker's role: hand ownership to the shm
+        # file itself so this process's tracker doesn't unlink/warn
+        resource_tracker.unregister(seg._name, "shared_memory")
+        seg.close()
+        return seg.name
+
+    dead_name = make(dead_pid, 1)
+    live_name = make(os.getpid(), 2)
+    try:
+        with HealthMonitor() as mon:
+            swept = decode_pool.sweep_orphaned_segments()
+        assert swept >= 1
+        listing = set(os.listdir(decode_pool._SHM_DIR))
+        assert dead_name not in listing
+        assert live_name in listing
+        assert mon.events(health.DECODE_POOL_SHM_SWEPT)
+    finally:
+        try:
+            os.unlink(os.path.join(decode_pool._SHM_DIR, live_name))
+        except OSError:
+            pass
+
+
+def test_pool_startup_runs_orphan_sweep():
+    """DecodePool() itself sweeps before spawning — the kill -9 resume
+    path reclaims the dead run's segments with zero operator action."""
+    import subprocess
+    import sys
+    from multiprocessing import resource_tracker, shared_memory
+
+    if not os.path.isdir(decode_pool._SHM_DIR):
+        pytest.skip("no /dev/shm on this platform")
+    proc = subprocess.Popen([sys.executable, "-c", "pass"])
+    proc.wait()
+    seg = shared_memory.SharedMemory(
+        name=f"{decode_pool._SHM_PREFIX}_{proc.pid:x}_{proc.pid:x}_9",
+        create=True, size=64)
+    resource_tracker.unregister(seg._name, "shared_memory")
+    seg.close()
+    pool = DecodePool(workers=1)
+    try:
+        assert seg.name not in set(os.listdir(decode_pool._SHM_DIR))
+    finally:
+        pool.close()

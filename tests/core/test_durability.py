@@ -1,0 +1,245 @@
+"""Durable job recovery: job identity, write-ahead journal, atomic spill,
+resume semantics (docs/RESILIENCE.md "Durable recovery")."""
+
+import json
+import os
+
+import pyarrow as pa
+import pytest
+
+from sparkdl_tpu.core import durability, health
+from sparkdl_tpu.core.durability import PartitionJournal
+from sparkdl_tpu.core.health import HealthMonitor
+from sparkdl_tpu.engine import DataFrame, EngineConfig
+
+_DEFAULTS = EngineConfig.snapshot()
+
+
+@pytest.fixture(autouse=True)
+def _restore_engine_config():
+    yield
+    for k, v in _DEFAULTS.items():
+        setattr(EngineConfig, k, v)
+
+
+def _batch(lo, hi):
+    return pa.record_batch([pa.array(list(range(lo, hi)))], names=["x"])
+
+
+def make_df(n=12, parts=4):
+    return DataFrame.fromRows([{"x": i} for i in range(n)],
+                              numPartitions=parts)
+
+
+# -- job identity ------------------------------------------------------------
+
+def test_job_id_stable_across_equal_plans():
+    def build():
+        df = make_df()
+        return df.withColumn("y", lambda x: x + 1, ["x"], pa.int64())
+
+    a, b = build(), build()
+    assert (durability.job_id(a._partitions, a._schema, a._ops)
+            == durability.job_id(b._partitions, b._schema, b._ops))
+
+
+def test_job_id_sensitive_to_ops_data_and_captured_state():
+    df = make_df()
+    base = df.withColumn("y", lambda x: x + 1, ["x"], pa.int64())
+    ids = {durability.job_id(f._partitions, f._schema, f._ops) for f in (
+        base,
+        df.select("x"),                                    # different op
+        base.select("y"),                                  # extra op
+        make_df(16, 4).withColumn(                          # different data
+            "y", lambda x: x + 1, ["x"], pa.int64()),
+    )}
+    assert len(ids) == 4
+    # captured closure state distinguishes same-qualname plans
+    assert (durability.job_id(*[getattr(df.select("x"), a) for a in
+                                ("_partitions", "_schema", "_ops")])
+            != durability.job_id(*[getattr(df.select("x", "x"), a) for a in
+                                   ("_partitions", "_schema", "_ops")]))
+
+
+def test_maybe_journal_opt_in_only(tmp_path):
+    df = make_df().select("x")
+    assert EngineConfig.durable_dir is None
+    assert durability.maybe_journal(df._partitions, df._schema,
+                                    df._ops) is None
+    EngineConfig.durable_dir = str(tmp_path)
+    # no ops -> nothing to recover; stays off even when opted in
+    plain = make_df()
+    assert durability.maybe_journal(plain._partitions, plain._schema,
+                                    plain._ops) is None
+    assert durability.maybe_journal(df._partitions, df._schema,
+                                    df._ops) is not None
+
+
+# -- journal mechanics -------------------------------------------------------
+
+def test_commit_load_roundtrip_bit_identical(tmp_path):
+    j = PartitionJournal(str(tmp_path), "job-a", 2)
+    b0, b1 = _batch(0, 5), _batch(5, 9)
+    j.commit(0, b0)
+    j.commit(1, b1, quarantined=True)
+
+    j2 = PartitionJournal(str(tmp_path), "job-a", 2)
+    assert j2.resume() == {0, 1}
+    assert j2.load(0).equals(b0) and j2.load(1).equals(b1)
+    recs = j2.records()
+    assert [r["partition"] for r in recs] == [0, 1]
+    assert [r["quarantined"] for r in recs] == [False, True]
+
+
+def test_commit_idempotent_and_attempts_counted(tmp_path):
+    j = PartitionJournal(str(tmp_path), "job-b", 1)
+    j.note_attempt(0)
+    j.note_attempt(0)
+    j.commit(0, _batch(0, 3))
+    j.commit(0, _batch(100, 103))  # hedge loser: no-op
+    assert j.load(0).equals(_batch(0, 3))
+    assert j.records()[0]["attempts"] == 2
+
+
+def test_torn_journal_tail_discarded_never_trusted(tmp_path):
+    j = PartitionJournal(str(tmp_path), "job-c", 2)
+    j.commit(0, _batch(0, 4))
+    j.commit(1, _batch(4, 8))
+    path = os.path.join(str(tmp_path), "job-c", "journal.jsonl")
+    lines = open(path).read().splitlines()
+    # crash mid-append: last record torn
+    with open(path, "w") as f:
+        f.write(lines[0] + "\n" + lines[1][:len(lines[1]) // 2])
+    with HealthMonitor() as mon:
+        j2 = PartitionJournal(str(tmp_path), "job-c", 2)
+        assert j2.resume() == {0}
+    assert mon.events(health.DURABLE_JOURNAL_TORN)
+    assert not j2.committed(1)
+
+
+def test_tampered_record_body_fails_line_digest(tmp_path):
+    j = PartitionJournal(str(tmp_path), "job-d", 1)
+    j.commit(0, _batch(0, 4))
+    path = os.path.join(str(tmp_path), "job-d", "journal.jsonl")
+    obj = json.loads(open(path).read())
+    obj["rec"]["attempts"] = 99  # bit-rot / tamper: crc no longer matches
+    with open(path, "w") as f:
+        f.write(json.dumps(obj) + "\n")
+    j2 = PartitionJournal(str(tmp_path), "job-d", 1)
+    assert j2.resume() == set()
+
+
+def test_corrupt_spill_dropped_and_partition_recomputes(tmp_path):
+    j = PartitionJournal(str(tmp_path), "job-e", 2)
+    j.commit(0, _batch(0, 4))
+    j.commit(1, _batch(4, 8))
+    spill = os.path.join(str(tmp_path), "job-e", "part-00001.arrow")
+    raw = bytearray(open(spill, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF
+    with open(spill, "wb") as f:
+        f.write(raw)
+    with HealthMonitor() as mon:
+        j2 = PartitionJournal(str(tmp_path), "job-e", 2)
+        assert j2.resume() == {0}  # bad spill discarded, not trusted
+    assert mon.events(health.DURABLE_JOURNAL_TORN)
+    # the discarded record is gone from the rewritten journal too
+    j3 = PartitionJournal(str(tmp_path), "job-e", 2)
+    assert j3.resume() == {0}
+
+
+# -- engine integration ------------------------------------------------------
+
+def test_durable_materialize_resumes_zero_recompute(tmp_path):
+    EngineConfig.durable_dir = str(tmp_path)
+    calls = []
+
+    def build():
+        def op(batch):
+            calls.append(len(batch))
+            return pa.compute.add(batch.column("x"), 1)
+        return make_df().withColumnBatch("y", op, outputType=pa.int64())
+
+    want = build().collect()
+    n_first = len(calls)
+    assert n_first == 4  # one compute per partition
+
+    with HealthMonitor() as mon:
+        got = build().collect()  # fresh frame, same plan -> same job id
+    assert got == want
+    assert len(calls) == n_first  # zero re-runs: all served from spill
+    assert mon.events(health.DURABLE_RESUMED)
+    assert len(mon.events(health.DURABLE_PARTITION_RESTORED)) == 4
+
+
+def test_durable_stream_resumes_in_original_order(tmp_path):
+    EngineConfig.durable_dir = str(tmp_path)
+    calls = []
+
+    def build():
+        def op(batch):
+            calls.append(len(batch))
+            return pa.compute.add(batch.column("x"), 1)
+        return make_df().withColumnBatch("y", op, outputType=pa.int64())
+
+    want = [b for b in build().streamPartitions()]
+    n_first = len(calls)
+    got = [b for b in build().streamPartitions()]
+    assert len(calls) == n_first
+    assert len(got) == len(want) == 4
+    for g, w in zip(got, want):
+        assert g.equals(w)
+
+
+def test_durable_partial_run_resumes_only_missing(tmp_path):
+    EngineConfig.durable_dir = str(tmp_path)
+
+    def build(calls):
+        def op(batch):
+            calls.append(batch.column("x")[0].as_py())
+            return pa.compute.add(batch.column("x"), 1)
+        return make_df().withColumnBatch("y", op, outputType=pa.int64())
+
+    # simulate a crashed first run: commit partitions 0 and 2 by hand
+    df = build([])
+    job = durability.job_id(df._partitions, df._schema, df._ops)
+    j = PartitionJournal(str(tmp_path), job, 4)
+    ops = df._ops
+    for i in (0, 2):
+        out = df._partitions[i]
+        for op in ops:
+            out = op(out)
+        j.commit(i, out)
+
+    calls = []
+    rows = build(calls).collect()
+    assert sorted(calls) == [3, 9]  # only partitions 1 and 3 computed
+    assert [r["y"] for r in rows] == [i + 1 for i in range(12)]
+
+
+def test_durable_dir_unset_identical_behavior(tmp_path):
+    calls = []
+
+    def op(batch):
+        calls.append(1)
+        return pa.compute.add(batch.column("x"), 1)
+
+    df = make_df().withColumnBatch("y", op, outputType=pa.int64())
+    df.collect()
+    df2 = make_df().withColumnBatch("y", op, outputType=pa.int64())
+    df2.collect()
+    assert len(calls) == 8  # no journal, no resume: both runs compute
+    assert list(os.listdir(tmp_path)) == []
+
+
+# -- run-id pinning ----------------------------------------------------------
+
+def test_pinned_run_id_stable_across_processes(tmp_path):
+    a = durability.pinned_run_id(str(tmp_path))
+    b = durability.pinned_run_id(str(tmp_path))
+    assert a == b and a.startswith("sparkdl-durable-")
+
+
+def test_pinned_run_id_respects_existing_winner(tmp_path):
+    with open(tmp_path / "run_id", "w") as f:
+        f.write("winner-1234\n")
+    assert durability.pinned_run_id(str(tmp_path)) == "winner-1234"

@@ -1,0 +1,13 @@
+"""Seeded violation: in-place write of durable state (atomic-write).
+
+The filename matters: the rule scopes to state-persisting modules
+(durability.py, checkpoint.py, baseline.py, telemetry.py).
+"""
+
+import json
+
+
+def commit(path, records):
+    with open(path, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")

@@ -81,6 +81,7 @@ EXPECTED_FIXTURE_RULES = {
     "span_name_typo.py": {"span-names"},
     "health_bare_string.py": {"health-constants"},
     "slo_metric_typo.py": {"slo-metrics"},
+    "state/durability.py": {"atomic-write"},
     "suppression_no_reason.py": {"blocking-under-lock",
                                  "suppression-hygiene"},
 }
