@@ -154,6 +154,27 @@ def make_slope_measurer(apply_fn, variables, x_np, ks=(2, 18), repeats=4):
     return measure
 
 
+def measured_flops_per_image(apply_fn, variables, x_np, fallback):
+    """Forward FLOPs/image from the compiler's own cost model
+    (``jax.jit(fn).lower(...).cost_analysis()`` — the compiled variant
+    returns a LIST of per-computation dicts on some backends, handled
+    here), falling back to the registry's analytic 2*MACs constant
+    (``ModelSpec.flops_per_image``) when the backend reports none.
+    Returns ``(flops_per_image, source)``."""
+    import jax
+
+    try:
+        cost = jax.jit(apply_fn).lower(variables, x_np).cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else None
+        flops = float(cost.get("flops", 0.0)) if cost else 0.0
+        if flops > 0:
+            return flops / x_np.shape[0], "cost_analysis"
+    except Exception:  # noqa: BLE001 - the cost model is best-effort
+        pass
+    return float(fallback), "registry_constant"
+
+
 def bench_device_featurize(name, size, flops_per_img):
     """Best of 3 measurements: the real chip's clock state drifts between
     consecutive runs (measured 10.1k -> 7.8k across back-to-back processes
@@ -180,6 +201,10 @@ def bench_device_featurize(name, size, flops_per_img):
     rng = np.random.default_rng(0)
     x = rng.integers(0, 255, size=(HEADLINE_BATCH,) + size + (3,)
                      ).astype(np.float32)
+    spec = registry.get_model_spec(name)
+    flops, flops_src = measured_flops_per_image(
+        mf.apply_fn, mf.variables, x,
+        spec.flops_per_image or flops_per_img)
     measure = make_slope_measurer(mf.apply_fn, mf.variables, x)
     measure()  # discarded warmup: compile residue + clock ramp
     runs = [measure() for _ in range(3)]
@@ -188,8 +213,10 @@ def bench_device_featurize(name, size, flops_per_img):
     # cross-run spread over the recorded (all-steady) runs, alongside
     # the winning run's own long-loop spread
     cross = (max(values) - min(values)) / min(values)
-    mfu = ips * flops_per_img / 1e12 / PEAK_TFLOPS_BF16
-    return ips, max(spread, cross), mfu, [round(v, 1) for v in values]
+    mfu = ips * flops / 1e12 / PEAK_TFLOPS_BF16
+    return (ips, max(spread, cross), mfu, [round(v, 1) for v in values],
+            {"flops_per_image": round(flops / 1e9, 3),
+             "flops_source": flops_src})
 
 
 def _write_jpegs(directory, n, rng):
@@ -620,6 +647,123 @@ def bench_durable_ingest(n_images=256):
             1 - ips_on / max(ips_off, 1e-9))
 
 
+def bench_precision_featurize(name="EfficientNetB0", n_images=128,
+                              size=(224, 224), batch_size=64):
+    """ISSUE 12 satellite: fp32 / bf16 / int8 featurize throughput AND
+    max output delta vs fp32 in ONE record, through the engine choke
+    point (``EngineConfig.inference_precision`` → executor → ``with_dtype``)
+    so the measured path is exactly what pipelines run. On CPU smoke the
+    throughputs may be neutral; the deltas are the portable part."""
+    from sparkdl_tpu.core import executor as device_executor
+    from sparkdl_tpu.engine.dataframe import EngineConfig
+    from sparkdl_tpu.models import registry
+
+    mf = registry.build_featurizer(name, weights="random")
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 255, size=(n_images,) + size + (3,)
+                     ).astype(np.float32)
+    saved = EngineConfig.snapshot()
+    results = {}
+    base = None
+    out = {}
+    try:
+        for precision in ("float32", "bfloat16", "int8"):
+            EngineConfig.inference_precision = precision
+            device_executor.reset()
+
+            def run():
+                out["y"] = device_executor.execute(mf, x,
+                                                   batch_size=batch_size)
+
+            run()  # warmup: compile the precision variant
+            best, spread = _best_of(run)
+            y = np.asarray(out["y"], np.float32)
+            if base is None:
+                base = y
+            delta = float(np.abs(y - base).max())
+            results[precision] = {
+                "images_per_sec": round(n_images / best, 2),
+                "spread": round(spread, 4),
+                "max_delta_vs_fp32": delta,
+                # normalized by the fp32 output scale — random-weight
+                # features are tiny, so the absolute delta alone misreads
+                "max_rel_delta_vs_fp32": round(
+                    delta / max(float(np.abs(base).max()), 1e-30), 6),
+            }
+    finally:
+        device_executor.reset()
+        EngineConfig.restore(saved)
+    return results
+
+
+def bench_bucket_ladder(sizes=(17, 17, 17, 17, 9, 23), batch_size=64,
+                        feat_dim=256):
+    """ISSUE 12 tentpole leg: skewed partition sizes (nothing near a
+    power-of-two rung) through the executor, blind pow2 ladder vs the
+    telemetry-tuned planner in ONE record. The planner is warmed past the
+    retune threshold first; the measured scope then reads the
+    POST-tuning padding-waste gauge, which must come in strictly below
+    the pow2 run's (the acceptance gate)."""
+    import jax.numpy as jnp
+
+    from sparkdl_tpu.core import batching, telemetry
+    from sparkdl_tpu.core import executor as device_executor
+    from sparkdl_tpu.core.model_function import ModelFunction, TensorSpec
+    from sparkdl_tpu.engine.dataframe import EngineConfig
+
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=(feat_dim, 64)).astype(np.float32)
+                    * 0.05)
+
+    def apply_fn(vs, x):
+        return jnp.tanh(x @ vs)
+
+    chunks = [rng.normal(size=(n, feat_dim)).astype(np.float32)
+              for n in sizes]
+    rows_per_pass = sum(sizes)
+    # enough passes to cross the retune threshold at least twice
+    warm_passes = (2 * batching.PLANNER_UPDATE_EVERY) // len(sizes) + 1
+    saved = EngineConfig.snapshot()
+    results = {}
+    try:
+        for ladder in ("pow2", "tuned"):
+            EngineConfig.bucket_ladder = ladder
+            batching.reset_planners()
+            device_executor.reset()
+            mf = ModelFunction(apply_fn, w,
+                               TensorSpec((None, feat_dim), "float32"),
+                               name=f"ladder_{ladder}")
+
+            def run():
+                for c in chunks:
+                    device_executor.execute(mf, c, batch_size=batch_size)
+
+            # warm under a live scope: compiles + the observation stream
+            # the retune feeds on (the waste gauge gates retunes)
+            with telemetry.Telemetry(f"bench_ladder_warm_{ladder}") as warm:
+                for _ in range(warm_passes):
+                    run()
+                updates = int(warm.metrics.snapshot()["counters"].get(
+                    telemetry.M_BUCKET_LADDER_UPDATE, 0))
+            # measured: a FRESH scope so the gauge reflects only the
+            # post-tuning steady state
+            with telemetry.Telemetry(f"bench_ladder_{ladder}") as tel:
+                best, spread = _best_of(run)
+                snap = tel.metrics.snapshot()
+            results[ladder] = {
+                "rows_per_sec": round(rows_per_pass / best, 2),
+                "spread": round(spread, 4),
+                "padding_waste": round(
+                    snap["gauges"].get(telemetry.M_PADDING_WASTE, 0.0), 4),
+                "ladder_updates": updates,
+            }
+    finally:
+        device_executor.reset()
+        batching.reset_planners()
+        EngineConfig.restore(saved)
+    return results
+
+
 def bench_batch_inference(name, n_images=256, size=(224, 224)):
     """Config 2: DeepImagePredictor over an in-memory image DataFrame."""
     import jax.numpy as jnp
@@ -842,11 +986,11 @@ def main():
         # headline measured and emitted FIRST (so a truncated run still
         # records it), then re-emitted verbatim as the LAST line (the
         # driver parses the final line)
-        ips, spread, mfu, runs = bench_device_featurize(
+        ips, spread, mfu, runs, flops = bench_device_featurize(
             "InceptionV3", (299, 299), FLOPS_PER_IMG_INCEPTION)
         headline = emit("images/sec/chip (InceptionV3 featurize)", ips,
                         "images/sec/chip", spread=round(spread, 4),
-                        mfu=round(mfu, 4), runs=runs)
+                        mfu=round(mfu, 4), runs=runs, flops=flops)
         if not headline_only:
             e2e, sp, e2e_tel = bench_e2e_featurize()
             emit("e2e images/sec (files->readImages->InceptionV3 featurize)",
@@ -910,6 +1054,35 @@ def main():
                  durable_off_spread=round(dsp_off, 4),
                  overhead_frac=round(dfrac, 4))
 
+            # raw-speed inference (ISSUE 12): the precision ladder —
+            # fp32/bf16/int8 throughput AND max output delta, one record
+            prec = bench_precision_featurize()
+            emit("precision featurize images/sec (EfficientNetB0 "
+                 "fp32/bf16/int8, engine choke point)",
+                 prec["bfloat16"]["images_per_sec"], "images/sec",
+                 fp32=prec["float32"], bf16=prec["bfloat16"],
+                 int8=prec["int8"],
+                 bf16_speedup=round(
+                     prec["bfloat16"]["images_per_sec"]
+                     / max(prec["float32"]["images_per_sec"], 1e-9), 4),
+                 int8_speedup=round(
+                     prec["int8"]["images_per_sec"]
+                     / max(prec["float32"]["images_per_sec"], 1e-9), 4))
+            # launch shaping (ISSUE 12): skewed partition sizes, blind
+            # pow2 ladder vs telemetry-tuned planner — the post-tuning
+            # padding-waste gauge must come in strictly below pow2's
+            lad = bench_bucket_ladder()
+            emit("tuned-ladder featurize rows/sec (skewed partitions "
+                 "17/9/23, batch 64)",
+                 lad["tuned"]["rows_per_sec"], "rows/sec",
+                 spread=lad["tuned"]["spread"], pow2=lad["pow2"],
+                 tuned=lad["tuned"],
+                 padding_waste_pow2=lad["pow2"]["padding_waste"],
+                 padding_waste_tuned=lad["tuned"]["padding_waste"],
+                 waste_strictly_reduced=(
+                     lad["tuned"]["padding_waste"]
+                     < lad["pow2"]["padding_waste"]))
+
             for name, size in (("ResNet50", (224, 224)),
                                ("Xception", (299, 299))):
                 ips, sp = bench_batch_inference(name, size=size)
@@ -945,10 +1118,11 @@ def main():
 
             # device throughput for the other flagship CNN: ResNet50's big
             # uniform convs hit ~48% MFU (vs InceptionV3's branchy ~29%)
-            rips, _, rmfu, rruns = bench_device_featurize(
+            rips, _, rmfu, rruns, rflops = bench_device_featurize(
                 "ResNet50", (224, 224), FLOPS_PER_IMG_RESNET50)
             emit("images/sec/chip (ResNet50 featurize)", rips,
-                 "images/sec/chip", mfu=round(rmfu, 4), runs=rruns)
+                 "images/sec/chip", mfu=round(rmfu, 4), runs=rruns,
+                 flops=rflops)
 
             # ingestion-backed zoo coverage (VERDICT r4 #9): driver-capture
             # the generic keras layer-DAG walker's program so regressions
@@ -958,11 +1132,11 @@ def main():
             # regimes measured in docs/PERF.md.
             for name, flops in (("DenseNet121", FLOPS_PER_IMG_DENSENET121),
                                 ("EfficientNetB0", FLOPS_PER_IMG_EFFNETB0)):
-                iips, isp, imfu, iruns = bench_device_featurize(
+                iips, isp, imfu, iruns, iflops = bench_device_featurize(
                     name, (224, 224), flops)
                 emit(f"images/sec/chip ({name} featurize, ingested)", iips,
                      "images/sec/chip", spread=round(isp, 4),
-                     mfu=round(imfu, 4), runs=iruns)
+                     mfu=round(imfu, 4), runs=iruns, flops=iflops)
 
             # re-emit the headline as the final line for tail parsers
             print(json.dumps(headline), flush=True)

@@ -253,7 +253,7 @@ class SpanNamesRule(Rule):
 # executor-choke-point (ISSUE 5)
 # ---------------------------------------------------------------------------
 
-_DEVICE_ENTRY_ATTRS = {"apply_batch", "jitted"}
+_DEVICE_ENTRY_ATTRS = {"apply_batch", "jitted", "with_dtype"}
 #: The featurize/serving route that MUST go through the executor. The
 #: choke point itself (core/executor.py) and the model layer it wraps
 #: (core/model_function.py) live outside these scopes by design; the
@@ -262,7 +262,10 @@ CHOKE_SCOPES = ("ml", "udf", "engine", "image")
 
 
 def direct_device_entry_calls(tree: ast.AST) -> List[int]:
-    """Lines of direct ``.apply_batch(...)`` / ``.jitted(...)`` calls."""
+    """Lines of direct ``.apply_batch(...)`` / ``.jitted(...)`` /
+    ``.with_dtype(...)`` calls. ``jitted`` is flagged with or without
+    ``donate_batch=`` — both the donation decision and the launch route
+    belong to the executor choke point."""
     out = []
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call):
@@ -281,9 +284,13 @@ class ExecutorChokePointRule(Rule):
         "A transformer/UDF/engine op calling apply_batch or jitted "
         "directly silently regresses the featurize route to "
         "per-partition launches (docs/PERF.md 'Cross-partition "
-        "coalescing'), invisible until the next bench round. Only the "
-        "executor choke point and the model layer it wraps may touch "
-        "those methods.")
+        "coalescing'), invisible until the next bench round; a "
+        "per-call-site with_dtype or jitted(donate_batch=...) forks the "
+        "precision/donation decision away from "
+        "EngineConfig.inference_precision / inference_donate_buffers "
+        "(docs/PERF.md 'Launch shaping & precision'). Only the executor "
+        "choke point and the model layer it wraps may touch those "
+        "methods.")
 
     def check(self, src: SourceFile) -> List[Finding]:
         parts = set(pathlib.PurePath(src.rel).parts)
@@ -291,9 +298,10 @@ class ExecutorChokePointRule(Rule):
             return []
         return [self.finding(
             src, line,
-            "direct apply_batch/jitted call on the engine featurize "
-            "route — device entry must go through "
-            "core.executor.execute (the coalescing choke point)")
+            "direct apply_batch/jitted/with_dtype call on the engine "
+            "featurize route — device entry, precision, and donation "
+            "must go through core.executor.execute and EngineConfig "
+            "(the coalescing choke point)")
             for line in direct_device_entry_calls(src.tree)]
 
 

@@ -347,6 +347,8 @@ class _FnState:
         self.window_s: Optional[float] = None  # None = adaptive
         self.cap = batch_size
         self.overload: OverloadPolicy = _NO_OVERLOAD
+        self.donate = False  # staged batches donated to their launches
+        self.planner: Optional[batching.BucketPlanner] = None
         self.latency_ewma: Optional[float] = None
         self.thread: Optional[threading.Thread] = None
         self.last_used = time.monotonic()
@@ -394,7 +396,9 @@ class DeviceExecutor:
                window_s: Optional[float], cap: int,
                prefetch: int, *, priority: str = PRIORITY_BULK,
                deadline: Optional[resilience.Deadline] = None,
-               overload: OverloadPolicy = _NO_OVERLOAD) -> Any:
+               overload: OverloadPolicy = _NO_OVERLOAD,
+               donate: bool = False,
+               planner: Optional[batching.BucketPlanner] = None) -> Any:
         """Run ``rows`` staged rows through the model, coalescing with any
         concurrent sibling requests against the same compiled fn. Returns
         host numpy (structure mirrors the model output). Blocking.
@@ -402,13 +406,17 @@ class DeviceExecutor:
         ``priority`` picks the lane (interactive drains first, bulk sheds
         first); ``deadline`` bounds the blocking-admission wait and lets
         the coalescer drop this request unlaunched once expired;
-        ``overload`` carries the admission/breaker knob snapshot."""
+        ``overload`` carries the admission/breaker knob snapshot;
+        ``donate`` donates staged batches to their launches (its jitted
+        variant is a distinct compiled fn, hence a distinct coalescing
+        state); ``planner`` is the telemetry-tuned bucket ladder for the
+        coalescer's pad choice and the replay paths."""
         if priority not in PRIORITIES:
             # a typo'd lane would queue into a lane the coalescer never
             # drains — the caller would hang forever, not error
             raise ValueError(
                 f"priority must be one of {PRIORITIES}, got {priority!r}")
-        fn = model.jitted(mesh=mesh)
+        fn = model.jitted(mesh=mesh, donate_batch=donate)
         state = self._state(fn, model, batch_size, mesh, multiple)
         token = current_task_token()
         t0 = time.monotonic()
@@ -422,6 +430,8 @@ class DeviceExecutor:
             state.window_s = window_s
             state.cap = cap
             state.overload = overload
+            state.donate = donate
+            state.planner = planner
             is_probe = self._breaker_admit_locked(state)
             try:
                 if deadline is not None and deadline.expired():
@@ -511,7 +521,8 @@ class DeviceExecutor:
             with self._breaker_observe(state, is_probe=is_probe):
                 return model.apply_batch(tree, batch_size=batch_size,
                                          mesh=mesh, retry_policy=policy,
-                                         prefetch=prefetch)
+                                         prefetch=prefetch, donate=donate,
+                                         planner=planner)
         finally:
             with state.cond:
                 state.inflight -= 1
@@ -556,7 +567,8 @@ class DeviceExecutor:
                     return state.model.apply_batch(
                         request.tree, batch_size=state.batch_size,
                         mesh=state.mesh, retry_policy=request.policy,
-                        prefetch=0)
+                        prefetch=0, donate=state.donate,
+                        planner=state.planner)
             finally:
                 with state.cond:
                     state.note_latency(time.monotonic() - t0)
@@ -576,7 +588,8 @@ class DeviceExecutor:
                 host = state.model.apply_batch(
                     request.tree, batch_size=state.batch_size,
                     mesh=state.mesh, retry_policy=request.policy,
-                    prefetch=0)
+                    prefetch=0, donate=state.donate,
+                    planner=state.planner)
         self._breaker_note(state, None, is_probe=request.is_probe)
         with state.cond:
             state.note_latency(time.monotonic() - t0)
@@ -1117,8 +1130,16 @@ class DeviceExecutor:
             treedef = flat[0][1]
             cat_leaves = [np.concatenate([f[0][j] for f in flat], axis=0)
                           for j in range(len(flat[0][0]))]
-            bucket = batching.bucket_size(total_rows, state.cap,
-                                          state.multiple)
+            planner = state.planner
+            if planner is not None:
+                # the coalesced launch stream feeds the same learned
+                # ladder as the chunked path; a cap tighter than the
+                # planner's batch_size falls back to pow2 inside
+                planner.observe(total_rows)
+                bucket = planner.bucket_for(total_rows, cap=state.cap)
+            else:
+                bucket = batching.bucket_size(total_rows, state.cap,
+                                              state.multiple)
             padded = treedef.unflatten(
                 [batching.pad_batch(leaf, bucket)[0]
                  for leaf in cat_leaves])
@@ -1298,16 +1319,28 @@ def execute(model: Any, array: Any, *, batch_size: int = 64,
     from sparkdl_tpu.engine.dataframe import EngineConfig
 
     EngineConfig.validate()  # read-time knob validation (clear ValueError)
+    # Precision and donation are decided HERE, once, from EngineConfig —
+    # never per call site (the choke-point lint flags transformers that
+    # try). "float32" leaves the model untouched: bit-identical escape
+    # hatch. with_dtype memoizes per precision, so the jit caches behind
+    # each variant are shared across calls.
+    if (EngineConfig.inference_precision != "float32"
+            and hasattr(model, "with_dtype")):
+        model = model.with_dtype(EngineConfig.inference_precision)
+    donate = EngineConfig.inference_donate_buffers
+    eff_batch, multiple = model.bucket_params(batch_size, mesh)
+    planner = batching.default_planner(
+        getattr(model, "name", "model"), eff_batch, multiple)
     if coalesce is None:
         coalesce = EngineConfig.coalesce
     if not coalesce:
         return model.apply_batch(array, batch_size=batch_size, mesh=mesh,
                                  retry_policy=retry_policy,
-                                 prefetch=prefetch)
+                                 prefetch=prefetch, donate=donate,
+                                 planner=planner)
     import jax
 
     array = model.stage_inputs(array)
-    eff_batch, multiple = model.bucket_params(batch_size, mesh)
     cap = eff_batch
     if EngineConfig.coalesce_max_rows is not None:
         cap = min(cap, int(EngineConfig.coalesce_max_rows))
@@ -1317,7 +1350,8 @@ def execute(model: Any, array: Any, *, batch_size: int = 64,
         # template) / already a full bucket or more: chunked path
         return model.apply_batch(array, batch_size=batch_size, mesh=mesh,
                                  retry_policy=retry_policy,
-                                 prefetch=prefetch)
+                                 prefetch=prefetch, donate=donate,
+                                 planner=planner)
     window_ms = EngineConfig.coalesce_window_ms
     window_s = None if window_ms is None else max(0.0, window_ms / 1e3)
     policy = (retry_policy if retry_policy is not None
@@ -1341,4 +1375,5 @@ def execute(model: Any, array: Any, *, batch_size: int = 64,
     return _service.submit(model, array, rows, batch_size, mesh, multiple,
                            policy, window_s, cap, prefetch,
                            priority=priority, deadline=deadline,
-                           overload=overload)
+                           overload=overload, donate=donate,
+                           planner=planner)

@@ -20,6 +20,7 @@ spec*:
 from __future__ import annotations
 
 import os
+import threading
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -52,6 +53,65 @@ class TensorSpec:
         return None
 
 
+#: Inference precisions :meth:`ModelFunction.with_dtype` accepts — the
+#: same vocabulary ``EngineConfig.inference_precision`` validates.
+PRECISIONS = ("float32", "bfloat16", "int8")
+
+# Marker keys of a quantized-weight leaf: a {_Q8_WEIGHTS: int8 array,
+# _Q8_SCALE: f32 per-channel scales} dict standing in for the original
+# float leaf. Dicts flatten transparently under jit, so the quantized
+# tree passes the jit boundary with no custom pytree registration.
+_Q8_WEIGHTS = "__sparkdl_q8_weights__"
+_Q8_SCALE = "__sparkdl_q8_scale__"
+
+
+def _is_q8_leaf(x) -> bool:
+    return isinstance(x, dict) and _Q8_WEIGHTS in x
+
+
+def _dequantize_tree(variables):
+    """In-program dequantize of every quantized leaf to bfloat16 (the
+    q · scale multiply fuses into the consuming matmul/conv); remaining
+    float leaves cast to bfloat16 so the model stays dtype-consistent."""
+    def deq(x):
+        if _is_q8_leaf(x):
+            return (x[_Q8_WEIGHTS].astype(jnp.bfloat16)
+                    * x[_Q8_SCALE].astype(jnp.bfloat16))
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(jnp.bfloat16)
+        return x
+
+    return jax.tree_util.tree_map(deq, variables, is_leaf=_is_q8_leaf)
+
+
+_DONATION_WARNING_MSG = "Some donated buffers were not usable"
+
+
+def _silence_donation_warning() -> None:
+    """uint8-staged batches can never alias float outputs, so XLA warns
+    "Some donated buffers were not usable" on every such launch; the
+    donation is still a correct no-op there, and the warning is pure
+    noise for a library-internal decision the caller didn't make.
+
+    Installed at module import (below) AND re-asserted per donating jit
+    build: jax's own tracing paths (e.g. ``jnp.mean`` via
+    ``jax._src.numpy.reductions``) enter ``warnings.catch_warnings()``,
+    whose exit RESTORES the process-global filter list from a snapshot —
+    a concurrent trace on another partition thread can therefore wipe a
+    filter installed after import, so presence is re-checked rather than
+    tracked with a one-shot flag."""
+    import warnings
+
+    for f in warnings.filters:
+        pattern = getattr(f[1], "pattern", None)
+        if pattern == _DONATION_WARNING_MSG:
+            return
+    warnings.filterwarnings("ignore", message=_DONATION_WARNING_MSG)
+
+
+_silence_donation_warning()
+
+
 class ModelFunction:
     """A pure ``apply(variables, x) -> y`` + variables + input spec.
 
@@ -77,8 +137,15 @@ class ModelFunction:
         # Trainer masks their updates. None = everything trainable.
         self.trainable_mask = trainable_mask
         self._jit_cache: Dict[Tuple, Callable] = {}
+        # Concurrent partition tasks race the first jitted() build; the
+        # executor keys its coalescing state on id(fn), so two racers
+        # minting distinct fns would silently split the coalescer into
+        # per-thread states (and recompile). Double-checked under this
+        # lock.
+        self._jit_lock = threading.Lock()
         self._flat_cache: Optional["ModelFunction"] = None
         self._resize_cache: Dict[Tuple[int, int], "ModelFunction"] = {}
+        self._precision_cache: Dict[str, "ModelFunction"] = {}
 
     # -- construction matrix (TFInputGraph parity) ---------------------------
 
@@ -278,8 +345,10 @@ class ModelFunction:
             # jnp.asarray first: an eager numpy input would otherwise flow
             # numpy's promotion rules through the graph (np-bf16 * python
             # float -> f32, unlike JAX's weak-type rules) and break
-            # dtype-strict convs mid-model
-            out = apply_fn(vs, jnp.asarray(x).astype(dtype))
+            # dtype-strict convs mid-model. tree.map, not a bare astype:
+            # multi-input models feed a dict of arrays.
+            x = jax.tree.map(lambda a: jnp.asarray(a).astype(dtype), x)
+            out = apply_fn(vs, x)
             return jax.tree.map(lambda o: o.astype(jnp.float32), out)
 
         out = ModelFunction(fn, variables, self.input_spec, name=self.name,
@@ -288,6 +357,83 @@ class ModelFunction:
         # model's msgpack artifact would otherwise store truncated values
         # that switching back to f32 cannot recover). Chain through an
         # existing source so re-casting a cast model keeps the original.
+        out.float_source = getattr(self, "float_source", self)
+        return out
+
+    def with_dtype(self, precision: str) -> "ModelFunction":
+        """The validated-knob precision entry point
+        (``EngineConfig.inference_precision`` threads through here at the
+        executor choke point — direct per-call-site use is flagged by the
+        ``executor-choke-point`` lint).
+
+        ``"float32"`` returns ``self`` untouched — the one-knob escape
+        hatch stays bit-identical to the unconverted model. ``"bfloat16"``
+        is :meth:`with_compute_dtype` (outputs cast back to float32;
+        per-element |Δ| ≤ ~1e-2 relative on tanh/softmax-bounded heads —
+        docs/PERF.md "Launch shaping & precision" for the contract).
+        ``"int8"`` post-training-quantizes the weights symmetric
+        per-channel (ndim≥2 float leaves; biases/norm stats stay float)
+        and computes in bfloat16. Memoized per precision so the jit cache
+        behind each variant is shared across calls.
+        """
+        if precision not in PRECISIONS:
+            raise ValueError(
+                f"precision must be one of {PRECISIONS}, got {precision!r}")
+        if precision == "float32":
+            return self
+        out = self._precision_cache.get(precision)
+        if out is None:
+            # build OUTSIDE the lock (int8 quantization fetches weights to
+            # host), publish under it with setdefault: concurrent first
+            # calls must converge on ONE variant — the executor's
+            # coalescing state is keyed on the variant's jitted fn
+            # identity, so two racing winners would silently split
+            # coalescing. A losing build is discarded unused.
+            if precision == "bfloat16":
+                built = self.with_compute_dtype(jnp.bfloat16)
+            else:
+                built = self._quantized_int8()
+            built.compute_dtype = precision
+            with self._jit_lock:
+                out = self._precision_cache.setdefault(precision, built)
+        return out
+
+    def _quantized_int8(self) -> "ModelFunction":
+        """Weight-only post-training quantization: symmetric per-channel
+        (last axis) int8 for every float leaf with ndim ≥ 2 — the
+        matmul/conv kernels that dominate featurize-head FLOPs and bytes.
+        Weights dequantize IN-PROGRAM to bfloat16 (q · scale fuses into
+        the consuming op), activations run bfloat16, outputs cast back to
+        float32. 4× smaller resident weights than float32 on top of the
+        bf16 math-speed win."""
+        apply_fn = self.apply_fn
+
+        def quant(a):
+            if not (hasattr(a, "dtype")
+                    and jnp.issubdtype(a.dtype, jnp.floating)
+                    and getattr(a, "ndim", 0) >= 2):
+                return a
+            arr = np.asarray(a, dtype=np.float32)
+            axes = tuple(range(arr.ndim - 1))
+            scale = np.max(np.abs(arr), axis=axes) / 127.0
+            scale = np.where(scale == 0.0, 1.0, scale).astype(np.float32)
+            return {_Q8_WEIGHTS: jnp.asarray(
+                        np.clip(np.rint(arr / scale), -127, 127)
+                        .astype(np.int8)),
+                    _Q8_SCALE: jnp.asarray(scale)}
+
+        variables = jax.tree.map(quant, self.variables)
+
+        def fn(vs, x):
+            deq = _dequantize_tree(vs)
+            x = jax.tree.map(
+                lambda a: jnp.asarray(a).astype(jnp.bfloat16), x)
+            out = apply_fn(deq, x)
+            return jax.tree.map(lambda o: o.astype(jnp.float32), out)
+
+        # trainable_mask dropped deliberately: quantized weights are an
+        # inference-only artifact, not a training starting point.
+        out = ModelFunction(fn, variables, self.input_spec, name=self.name)
         out.float_source = getattr(self, "float_source", self)
         return out
 
@@ -300,8 +446,10 @@ class ModelFunction:
         the remote PJRT tunnel).
         """
         if self._flat_cache is None:
-            self._flat_cache = self.with_postprocess(
-                lambda y: y.reshape(y.shape[0], -1))
+            with self._jit_lock:
+                if self._flat_cache is None:
+                    self._flat_cache = self.with_postprocess(
+                        lambda y: y.reshape(y.shape[0], -1))
         return self._flat_cache
 
     def resized(self, src_size: Tuple[int, int],
@@ -356,6 +504,17 @@ class ModelFunction:
         cached = self._jit_cache.get(key)
         if cached is not None:
             return cached
+        with self._jit_lock:
+            cached = self._jit_cache.get(key)
+            if cached is not None:
+                return cached
+            fn = self._build_jitted(mesh, donate_batch)
+            self._jit_cache[key] = fn
+            return fn
+
+    def _build_jitted(self, mesh, donate_batch: bool) -> Callable:
+        if donate_batch:
+            _silence_donation_warning()
 
         specs = self.input_spec
         inner_apply = self.apply_fn
@@ -412,7 +571,6 @@ class ModelFunction:
         # NOT functools' `__wrapped__` — a caller's own wraps()-decorated
         # fn must not have its inner fn traced by accident.
         fn.__sparkdl_trace_target__ = inner
-        self._jit_cache[key] = fn
         return fn
 
     def stage_inputs(self, array):
@@ -443,7 +601,9 @@ class ModelFunction:
 
     def apply_batch(self, array, batch_size: int = 64,
                     mesh=None, retry_policy=None,
-                    prefetch: int = 2) -> np.ndarray:
+                    prefetch: int = 2, donate: bool = False,
+                    planner: Optional[batching.BucketPlanner] = None
+                    ) -> np.ndarray:
         """Run over N rows with fixed-shape padded chunks; returns numpy.
 
         ``array``: one ndarray, or — for multi-input models whose
@@ -464,18 +624,35 @@ class ModelFunction:
         ``prefetch``: chunk-staging depth of the async input pipeline
         (core.pipeline; 0 = inline staging) — the featurize/transform
         analog of the Trainer's prefetcher (ISSUE 3).
+
+        ``donate=True`` donates each staged input chunk to its launch
+        (``jitted(donate_batch=True)``): XLA reuses the input's HBM for
+        the outputs, so peak memory drops by one batch. Host-staged numpy
+        chunks stay intact (donation only consumes the device-side
+        buffer) — the OOM re-chunk path re-pads from the host exactly as
+        before. A caller passing a device-resident ``jax.Array`` gives up
+        that buffer: reading it after the call raises.
+
+        ``planner``: telemetry-tuned bucket ladder (``core.batching``)
+        replacing the blind power-of-two tail buckets; must have been
+        built for this call's effective batch_size/multiple
+        (``batching.planner_for``). On an OOM re-run at a halved
+        batch_size the planner is dropped — its ladder no longer matches.
         """
         from sparkdl_tpu.core import resilience
 
         array = self.stage_inputs(array)
-        fn = self.jitted(mesh=mesh)
+        fn = self.jitted(mesh=mesh, donate_batch=donate)
         batch_size, multiple = self.bucket_params(batch_size, mesh)
+        if planner is not None and planner.batch_size != batch_size:
+            planner = None  # foreign ladder: fall back to pow2
         while True:
             try:
                 return batching.run_batched(fn, array, batch_size,
                                             multiple=multiple,
                                             retry_policy=retry_policy,
-                                            prefetch=prefetch)
+                                            prefetch=prefetch,
+                                            planner=planner)
             except Exception as e:  # noqa: BLE001 - classified below
                 half = batch_size // 2
                 if (resilience.classify(e) != resilience.OOM
@@ -487,6 +664,7 @@ class ModelFunction:
                     "%s: device OOM at batch_size %d (%s); re-running at %d",
                     self.name, batch_size, e, half)
                 batch_size = half
+                planner = None  # halved ladder: planner no longer matches
 
     def __call__(self, x) -> jax.Array:
         return self.apply_fn(self.variables, x)

@@ -130,6 +130,13 @@ M_BATCH_ROWS = "sparkdl.batching.rows"                 # counter (valid rows)
 M_BATCH_PAD_ROWS = "sparkdl.batching.pad_rows"         # counter (pad rows)
 M_BATCH_BUCKET_ROWS = "sparkdl.batching.bucket_rows"   # histogram
 M_PADDING_WASTE = "sparkdl.batching.padding_waste"     # gauge (pad fraction)
+# Telemetry-tuned bucket ladder (core/batching.BucketPlanner, docs/PERF.md
+# "Launch shaping & precision"): one counter bump per adopted ladder, and
+# the planner's predicted pad fraction under the ladder it just adopted
+# (the per-model padding waste AFTER tuning; the update counter's pace is
+# bounded by the planner's hysteresis).
+M_BUCKET_LADDER_UPDATE = "sparkdl.batching.bucket_ladder_update"  # counter
+M_PLANNER_WASTE = "sparkdl.batching.planner_waste"     # gauge (pad fraction)
 M_ENGINE_ROWS_OUT = "sparkdl.engine.rows_out"          # counter
 M_ENGINE_BYTES_OUT = "sparkdl.engine.bytes_out"        # counter
 # Device execution service (core/executor.py, docs/PERF.md coalescing):
@@ -166,6 +173,8 @@ CANONICAL_METRIC_KINDS: Dict[str, str] = {
     M_BATCH_PAD_ROWS: "counter",
     M_BATCH_BUCKET_ROWS: "histogram",
     M_PADDING_WASTE: "gauge",
+    M_BUCKET_LADDER_UPDATE: "counter",
+    M_PLANNER_WASTE: "gauge",
     M_ENGINE_ROWS_OUT: "counter",
     M_ENGINE_BYTES_OUT: "counter",
     M_COALESCE_REQUESTS: "histogram",

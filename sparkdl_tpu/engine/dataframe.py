@@ -86,6 +86,25 @@ class EngineConfig:
     coalesce_window_ms: Optional[float] = None
     # Row cap of one coalesced launch; None = the request's batch_size.
     coalesce_max_rows: Optional[int] = None
+    # -- raw-speed inference (docs/PERF.md "Launch shaping & precision") ------
+    # Numeric width of the featurize/transform path, applied at the
+    # executor choke point via ModelFunction.with_dtype. "bfloat16"
+    # (default): bf16 compute, outputs cast back to float32 (per-element
+    # tolerance contract in docs/PERF.md); "float32": the one-knob escape
+    # hatch, bit-identical to the pre-knob behavior; "int8": weight-only
+    # symmetric per-channel post-training quantization, bf16 activations.
+    inference_precision: str = "bfloat16"
+    # Donate each staged input batch to its launch so XLA reuses the
+    # input's HBM for the outputs — peak memory drops by ~one batch,
+    # which is direct headroom for the executor_max_queued_rows shed
+    # thresholds above.
+    inference_donate_buffers: bool = True
+    # Tail-bucket ladder: "tuned" (default) arms the per-model
+    # telemetry-tuned BucketPlanner (core/batching.py) — identical to
+    # the blind ladder until enough launches are observed, then rungs
+    # move to the observed size distribution; "pow2" restores the blind
+    # power-of-two ladder everywhere.
+    bucket_ladder: str = "tuned"
     # -- executor overload protection (core/executor.py, docs/RESILIENCE.md
     # "Overload & graceful degradation") ---------------------------------------
     # Admission control: per-compiled-fn bounds on queued requests / queued
@@ -172,7 +191,9 @@ class EngineConfig:
                  cls.task_timeout_s, cls.speculation_quantile,
                  cls.speculation_multiplier, cls.speculation_min_runtime_s,
                  cls.quarantine_max_fatal, cls.coalesce_window_ms,
-                 cls.coalesce_max_rows, cls.executor_max_queued_requests,
+                 cls.coalesce_max_rows, cls.inference_precision,
+                 cls.inference_donate_buffers, cls.bucket_ladder,
+                 cls.executor_max_queued_requests,
                  cls.executor_max_queued_rows, cls.executor_overload_mode,
                  cls.executor_default_priority,
                  cls.executor_breaker_threshold,
@@ -215,6 +236,19 @@ class EngineConfig:
         positive("coalesce_window_ms", cls.coalesce_window_ms,
                  exclusive=False)
         positive("coalesce_max_rows", cls.coalesce_max_rows)
+        if cls.inference_precision not in ("float32", "bfloat16", "int8"):
+            raise ValueError(
+                "EngineConfig.inference_precision must be 'float32', "
+                "'bfloat16' or 'int8', got "
+                f"{cls.inference_precision!r}")
+        if not isinstance(cls.inference_donate_buffers, bool):
+            raise ValueError(
+                "EngineConfig.inference_donate_buffers must be a bool, "
+                f"got {cls.inference_donate_buffers!r}")
+        if cls.bucket_ladder not in ("tuned", "pow2"):
+            raise ValueError(
+                "EngineConfig.bucket_ladder must be 'tuned' or 'pow2', "
+                f"got {cls.bucket_ladder!r}")
         positive("executor_max_queued_requests",
                  cls.executor_max_queued_requests)
         positive("executor_max_queued_rows", cls.executor_max_queued_rows)

@@ -75,13 +75,19 @@ class ModelSpec:
     classes: int = 1000
     # kwargs used to build the *featurize* (headless) variant
     featurize_kwargs: Optional[Dict[str, Any]] = None
+    # Forward FLOPs per image (2·MACs at the native input size) — the
+    # bench's MFU fallback when XLA cost_analysis is unavailable for a
+    # compiled featurize program. None = unknown (MFU omitted).
+    flops_per_image: Optional[float] = None
 
 
 SUPPORTED_MODELS: Dict[str, ModelSpec] = {
     "InceptionV3": ModelSpec(
-        "InceptionV3", InceptionV3, (299, 299), preprocess_tf_mode, 2048),
+        "InceptionV3", InceptionV3, (299, 299), preprocess_tf_mode, 2048,
+        flops_per_image=5.7e9),
     "ResNet50": ModelSpec(
-        "ResNet50", ResNet50, (224, 224), preprocess_caffe_mode, 2048),
+        "ResNet50", ResNet50, (224, 224), preprocess_caffe_mode, 2048,
+        flops_per_image=7.75e9),
     "ResNet101": ModelSpec(
         "ResNet101", ResNet101, (224, 224), preprocess_caffe_mode, 2048),
     "ResNet152": ModelSpec(
@@ -109,9 +115,11 @@ SUPPORTED_MODELS: Dict[str, ModelSpec] = {
 # in-model, so identity).
 _INGESTED_MODELS: Dict[str, ModelSpec] = {
     "DenseNet121": ModelSpec(
-        "DenseNet121", None, (224, 224), preprocess_torch_mode, 1024),
+        "DenseNet121", None, (224, 224), preprocess_torch_mode, 1024,
+        flops_per_image=5.7e9),
     "EfficientNetB0": ModelSpec(
-        "EfficientNetB0", None, (224, 224), preprocess_identity, 1280),
+        "EfficientNetB0", None, (224, 224), preprocess_identity, 1280,
+        flops_per_image=0.78e9),
     "MobileNetV3Small": ModelSpec(
         "MobileNetV3Small", None, (224, 224), preprocess_identity, 576),
     "NASNetMobile": ModelSpec(
@@ -360,13 +368,19 @@ def _fast_inference_apply(name: str, include_top: bool, dtype):
 
 def build_featurizer(name: str, weights="random", seed: int = 0,
                      dtype=None, preprocess: bool = True,
-                     fast: bool = True) -> ModelFunction:
+                     fast: bool = True,
+                     precision: Optional[str] = None) -> ModelFunction:
     """Headless named model as a ModelFunction emitting feature vectors.
 
     Input contract: float32 RGB [0,255] NHWC at the model's input size
     (host side resizes; scaling/mean-subtract runs on device, fused).
     ``fast=False`` forces the plain Flax-module apply even where an
-    inference-specialized fast path exists.
+    inference-specialized fast path exists. ``precision`` applies
+    :meth:`ModelFunction.with_dtype` to the finished featurizer
+    ("bfloat16" compute / "int8" weight-only PTQ; None or "float32"
+    leaves it untouched) — note the engine's executor choke point applies
+    ``EngineConfig.inference_precision`` itself, so this parameter is for
+    standalone (non-engine) use of the registry.
     """
     spec = get_model_spec(name)
     if is_ingested_model(name):
@@ -374,7 +388,7 @@ def build_featurizer(name: str, weights="random", seed: int = 0,
         if preprocess:
             mf = mf.with_preprocess(spec.preprocess)
         mf.fast_path = False
-        return mf
+        return _apply_precision(mf, precision)
     kwargs = dict(spec.featurize_kwargs or {"include_top": False,
                                             "pooling": "avg"})
     kwargs["dtype"] = dtype
@@ -391,20 +405,23 @@ def build_featurizer(name: str, weights="random", seed: int = 0,
     if preprocess:
         mf = mf.with_preprocess(spec.preprocess)
     mf.fast_path = fast_apply is not None
-    return mf
+    return _apply_precision(mf, precision)
 
 
 def build_predictor(name: str, weights="random", seed: int = 0,
                     dtype=None, preprocess: bool = True,
-                    fast: bool = True) -> ModelFunction:
-    """Full named model (softmax probabilities) as a ModelFunction."""
+                    fast: bool = True,
+                    precision: Optional[str] = None) -> ModelFunction:
+    """Full named model (softmax probabilities) as a ModelFunction.
+
+    ``precision``: see :func:`build_featurizer`."""
     spec = get_model_spec(name)
     if is_ingested_model(name):
         mf = _build_ingested(name, weights, include_top=True, dtype=dtype)
         if preprocess:
             mf = mf.with_preprocess(spec.preprocess)
         mf.fast_path = False
-        return mf
+        return _apply_precision(mf, precision)
     module = spec.builder(include_top=True, classes=spec.classes, dtype=dtype)
     input_spec = _spec_input(spec)
     variables = _resolve_variables(spec, module, weights, seed, input_spec)
@@ -418,7 +435,18 @@ def build_predictor(name: str, weights="random", seed: int = 0,
     if preprocess:
         mf = mf.with_preprocess(spec.preprocess)
     mf.fast_path = fast_apply is not None
-    return mf
+    return _apply_precision(mf, precision)
+
+
+def _apply_precision(mf: ModelFunction,
+                     precision: Optional[str]) -> ModelFunction:
+    """with_dtype pass-through keeping fast_path on the returned model."""
+    if precision is None or precision == "float32":
+        return mf
+    fast_path = mf.fast_path
+    out = mf.with_dtype(precision)
+    out.fast_path = fast_path
+    return out
 
 
 def build_keras_reference(name: str):

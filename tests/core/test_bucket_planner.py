@@ -1,0 +1,246 @@
+"""Telemetry-tuned bucket ladder (ISSUE 12 tentpole, core/batching.py):
+the bucket_size cap contract, cold-planner identity with the blind
+power-of-two ladder, bounded retunes with hysteresis, persistence beside
+the compilation cache, and the executor integration."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from sparkdl_tpu.core import batching, executor, telemetry
+from sparkdl_tpu.core.batching import BucketPlanner
+from sparkdl_tpu.core.model_function import ModelFunction, TensorSpec
+from sparkdl_tpu.core.telemetry import Telemetry
+from sparkdl_tpu.engine.dataframe import EngineConfig
+
+
+@pytest.fixture(autouse=True)
+def _fresh_planners():
+    saved = EngineConfig.snapshot()
+    batching.reset_planners()
+    executor.reset()
+    yield
+    executor.reset()
+    batching.reset_planners()
+    EngineConfig.restore(saved)
+
+
+# ---------------------------------------------------------------------------
+# bucket_size cap contract (satellite: cap applied before rounding)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n", [1, 3, 7, 8, 9, 16, 33, 40, 63, 64])
+@pytest.mark.parametrize("batch_size", [8, 40, 64, 100])
+@pytest.mark.parametrize("multiple", [1, 4, 16])
+def test_bucket_size_never_exceeds_rounded_cap(n, batch_size, multiple):
+    if n > batch_size:
+        pytest.skip("chunks never exceed batch_size on the chunked path")
+    b = batching.bucket_size(n, batch_size, multiple)
+    cap = -(-batch_size // multiple) * multiple  # roundup(batch_size)
+    assert b >= n
+    assert b % multiple == 0
+    # THE regression: the old code capped at the raw batch_size BEFORE
+    # rounding, so e.g. (n=40, batch_size=40, multiple=16) returned 48
+    # only by luck of ordering while (n=33, batch_size=40, multiple=16)
+    # could exceed the rounded cap; the contract is result <= roundup(cap)
+    assert b <= cap
+
+
+def test_bucket_size_known_values():
+    assert batching.bucket_size(40, 40, 16) == 48
+    assert batching.bucket_size(33, 40, 16) == 48
+    assert batching.bucket_size(5, 64) == 8
+    assert batching.bucket_size(64, 64) == 64
+    # n above batch_size (public-helper use): bucket covers n
+    assert batching.bucket_size(65, 64) == 65
+
+
+# ---------------------------------------------------------------------------
+# Cold planner == blind ladder
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("batch_size,multiple", [(64, 1), (40, 16),
+                                                 (128, 8), (100, 1), (7, 1)])
+def test_cold_planner_matches_pow2_ladder_exactly(batch_size, multiple):
+    planner = BucketPlanner(batch_size, multiple)
+    for n in range(1, batch_size + 1):
+        assert planner.bucket_for(n) == batching.bucket_size(
+            n, batch_size, multiple), n
+
+
+def test_plan_observes_and_returns_bucket():
+    planner = BucketPlanner(64)
+    assert planner.plan(5) == 8
+    assert planner.plan(64) == 64
+
+
+# ---------------------------------------------------------------------------
+# Retune: bounded, hysteresis-gated, waste-reducing
+# ---------------------------------------------------------------------------
+
+
+def _drive(planner, sizes, rounds):
+    for _ in range(rounds):
+        for n in sizes:
+            planner.observe(n)
+
+
+def test_skewed_stream_retunes_and_cuts_pad_rows():
+    planner = BucketPlanner(64)
+    _drive(planner, [17], rounds=2 * batching.PLANNER_UPDATE_EVERY)
+    # pow2 padded 17 -> 32 (15 pad rows/launch); the tuned ladder has a
+    # 17 rung, so the dominant launch pads zero
+    assert planner.bucket_for(17) == 17
+    # the cap rung survives every retune: any n <= batch_size is coverable
+    assert planner.bucket_for(64) == 64
+    assert planner.bucket_for(33) <= 64
+
+
+def test_rung_count_stays_bounded_by_pow2_ladder_length():
+    planner = BucketPlanner(64)
+    max_rungs = len(batching._pow2_ladder(64, 1, 8))
+    # adversarial: many distinct sizes, several retune rounds
+    rng = np.random.default_rng(0)
+    for _ in range(6):
+        _drive(planner, rng.integers(1, 65, size=16).tolist(),
+               rounds=batching.PLANNER_UPDATE_EVERY // 8)
+    assert len(planner.ladder()) <= max_rungs
+
+
+def test_pow2_aligned_stream_never_retunes():
+    planner = BucketPlanner(64)
+    before = planner.ladder()
+    # sizes already exactly on pow2 rungs: zero pad rows, nothing to win
+    _drive(planner, [8, 16, 32, 64],
+           rounds=4 * batching.PLANNER_UPDATE_EVERY)
+    assert planner.ladder() == before
+
+
+def test_adoptions_capped_at_max_updates():
+    planner = BucketPlanner(64)
+    rng = np.random.default_rng(1)
+    for round_i in range(batching.PLANNER_MAX_UPDATES * 3):
+        # shift the distribution every round to invite a retune
+        base = int(rng.integers(1, 60))
+        _drive(planner, [base], rounds=batching.PLANNER_UPDATE_EVERY)
+    assert planner._updates <= batching.PLANNER_MAX_UPDATES
+
+
+def test_adoption_emits_telemetry():
+    with Telemetry() as tel:
+        planner = BucketPlanner(64)
+        _drive(planner, [17], rounds=2 * batching.PLANNER_UPDATE_EVERY)
+        snap = tel.metrics.snapshot()
+    assert snap["counters"].get(telemetry.M_BUCKET_LADDER_UPDATE, 0) >= 1
+    assert snap["gauges"][telemetry.M_PLANNER_WASTE] < 0.05
+
+
+def test_mesh_multiple_respected_after_retune():
+    planner = BucketPlanner(64, multiple=8)
+    _drive(planner, [17, 19], rounds=2 * batching.PLANNER_UPDATE_EVERY)
+    for rung in planner.ladder():
+        assert rung % 8 == 0
+    assert planner.bucket_for(17) >= 17
+
+
+# ---------------------------------------------------------------------------
+# iter_batches integration
+# ---------------------------------------------------------------------------
+
+
+def test_iter_batches_uses_planner_ladder():
+    planner = BucketPlanner(64)
+    _drive(planner, [17], rounds=2 * batching.PLANNER_UPDATE_EVERY)
+    arr = np.ones((17, 3), np.float32)
+    [(chunk, n_valid)] = list(batching.iter_batches(arr, 64,
+                                                    planner=planner))
+    assert n_valid == 17
+    assert chunk.shape[0] == 17  # tuned rung, not the pow2 32
+    # planner-less default unchanged
+    [(chunk, _)] = list(batching.iter_batches(arr, 64))
+    assert chunk.shape[0] == 32
+
+
+# ---------------------------------------------------------------------------
+# Registry, knob gating, persistence
+# ---------------------------------------------------------------------------
+
+
+def test_planner_for_returns_one_shared_instance():
+    a = batching.planner_for("m", 64, 1)
+    b = batching.planner_for("m", 64, 1)
+    assert a is b
+    assert batching.planner_for("m", 32, 1) is not a
+
+
+def test_default_planner_honors_bucket_ladder_knob():
+    EngineConfig.bucket_ladder = "pow2"
+    assert batching.default_planner("m", 64, 1) is None
+    EngineConfig.bucket_ladder = "tuned"
+    assert batching.default_planner("m", 64, 1) is not None
+
+
+def test_ladder_persists_beside_compile_cache(tmp_path, monkeypatch):
+    from sparkdl_tpu import COMPILE_CACHE_DIR_ENV
+
+    monkeypatch.setenv(COMPILE_CACHE_DIR_ENV, str(tmp_path))
+    planner = batching.planner_for("persist_me", 64, 1)
+    _drive(planner, [17], rounds=2 * batching.PLANNER_UPDATE_EVERY)
+    tuned = planner.ladder()
+    assert tuned != batching._pow2_ladder(64, 1, 8)
+    path = batching.ladder_store_path()
+    assert path is not None and os.path.exists(path)
+    doc = json.load(open(path))
+    assert doc["version"] == 1
+    assert doc["ladders"]["persist_me|64|1"] == list(tuned)
+    # a "warm process" (fresh registry) reloads the learned ladder
+    batching.reset_planners()
+    warm = batching.planner_for("persist_me", 64, 1)
+    assert warm.ladder() == tuned
+
+
+def test_no_cache_dir_means_no_persistence(monkeypatch):
+    from sparkdl_tpu import COMPILE_CACHE_DIR_ENV
+
+    monkeypatch.delenv(COMPILE_CACHE_DIR_ENV, raising=False)
+    assert batching.ladder_store_path() is None
+    planner = batching.planner_for("ephemeral", 64, 1)
+    _drive(planner, [17], rounds=2 * batching.PLANNER_UPDATE_EVERY)
+    # retune still works, it just isn't written anywhere
+
+
+# ---------------------------------------------------------------------------
+# Executor integration: values identical through retunes
+# ---------------------------------------------------------------------------
+
+
+def _model(name="planner_model"):
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=(6, 3)).astype(np.float32))
+
+    def apply_fn(vs, x):
+        return jnp.tanh(x @ vs)
+
+    return ModelFunction(apply_fn, w, TensorSpec((None, 6), "float32"),
+                         name=name)
+
+
+def test_tuned_ladder_execute_stays_value_identical():
+    EngineConfig.bucket_ladder = "tuned"
+    mf = _model()
+    x = np.random.default_rng(2).normal(size=(17, 6)).astype(np.float32)
+    expected = mf.apply_batch(x, batch_size=64)
+    outs = []
+    # enough solo executes to cross the retune threshold several times
+    for _ in range(2 * batching.PLANNER_UPDATE_EVERY + 4):
+        outs.append(executor.execute(mf, x, batch_size=64))
+    planner = batching.planner_for(mf.name, 64, 1)
+    assert planner.bucket_for(17) == 17  # the ladder did adapt
+    for out in outs:
+        np.testing.assert_array_equal(out, expected)

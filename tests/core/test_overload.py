@@ -755,6 +755,13 @@ def test_shutdown_racing_concurrent_submits_never_hangs_or_leaks():
     ("executor_breaker_threshold", -1),
     ("executor_breaker_window_s", 0.0),
     ("executor_breaker_cooldown_s", -1.0),
+    ("inference_precision", "float16"),
+    ("inference_precision", "fp32"),
+    ("inference_precision", None),
+    ("inference_donate_buffers", "yes"),
+    ("inference_donate_buffers", 1),
+    ("bucket_ladder", "adaptive"),
+    ("bucket_ladder", None),
     ("max_workers", 0),
 ])
 def test_engine_config_validation_rejects(knob, value):

@@ -1,0 +1,227 @@
+"""Low-precision featurize + donated inference buffers (ISSUE 12
+tentpole): the with_dtype precision matrix (fp32 bit-identity escape
+hatch, bf16/int8 tolerance contract), EngineConfig threading through the
+executor choke point, and buffer donation semantics."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from sparkdl_tpu.core import batching, executor
+from sparkdl_tpu.core import model_function as mfn
+from sparkdl_tpu.core.model_function import ModelFunction, TensorSpec
+from sparkdl_tpu.engine.dataframe import EngineConfig
+
+# Documented tolerance contract (docs/PERF.md "Launch shaping &
+# precision") for bounded heads (tanh/softmax outputs in [-1, 1]):
+BF16_ATOL = 0.05
+INT8_ATOL = 0.15
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    saved = EngineConfig.snapshot()
+    batching.reset_planners()
+    executor.reset()
+    yield
+    executor.reset()
+    batching.reset_planners()
+    EngineConfig.restore(saved)
+
+
+def _model(name="prec_model"):
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=(6, 3)).astype(np.float32))
+
+    def apply_fn(vs, x):
+        return jnp.tanh(x @ vs)
+
+    return ModelFunction(apply_fn, w, TensorSpec((None, 6), "float32"),
+                         name=name)
+
+
+def _rows(n, seed=1):
+    return np.random.default_rng(seed).normal(size=(n, 6)).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# with_dtype semantics
+# ---------------------------------------------------------------------------
+
+
+def test_float32_is_identity_escape_hatch():
+    mf = _model()
+    assert mf.with_dtype("float32") is mf
+
+
+def test_with_dtype_rejects_unknown_precision():
+    with pytest.raises(ValueError, match="precision"):
+        _model().with_dtype("float16")
+
+
+def test_with_dtype_memoized_per_precision():
+    mf = _model()
+    assert mf.with_dtype("bfloat16") is mf.with_dtype("bfloat16")
+    assert mf.with_dtype("int8") is mf.with_dtype("int8")
+    assert mf.with_dtype("bfloat16") is not mf.with_dtype("int8")
+
+
+def test_bf16_within_tolerance_outputs_float32():
+    mf = _model()
+    x = _rows(32)
+    base = mf.apply_batch(x, batch_size=16)
+    out = mf.with_dtype("bfloat16").apply_batch(x, batch_size=16)
+    assert out.dtype == np.float32  # cast back at the program edge
+    np.testing.assert_allclose(out, base, atol=BF16_ATOL)
+
+
+def test_int8_within_tolerance_outputs_float32():
+    mf = _model()
+    x = _rows(32)
+    base = mf.apply_batch(x, batch_size=16)
+    out = mf.with_dtype("int8").apply_batch(x, batch_size=16)
+    assert out.dtype == np.float32
+    np.testing.assert_allclose(out, base, atol=INT8_ATOL)
+
+
+def test_int8_quantizes_matrix_leaves_symmetric_per_channel():
+    mf = _model().with_dtype("int8")
+    leaf = mf.variables  # single weight matrix -> one q8 marker dict
+    assert mfn._is_q8_leaf(leaf)
+    q = np.asarray(leaf[mfn._Q8_WEIGHTS])
+    scale = np.asarray(leaf[mfn._Q8_SCALE])
+    assert q.dtype == np.int8
+    assert scale.shape == (3,)  # per output channel (last axis)
+    assert np.abs(q).max() <= 127
+    # symmetric: dequantized max per channel reproduces the fp32 max
+    w = np.asarray(_model().variables)
+    np.testing.assert_allclose(np.abs(q * scale).max(axis=0),
+                               np.abs(w).max(axis=0), rtol=0.02)
+
+
+def test_precision_models_keep_float_source_for_persistence():
+    mf = _model()
+    assert mf.with_dtype("bfloat16").float_source is mf
+    assert mf.with_dtype("int8").float_source is mf
+
+
+def test_with_compute_dtype_handles_dict_inputs():
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=(4, 3)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(4, 3)).astype(np.float32))
+
+    def apply_fn(vs, x):
+        return jnp.tanh(x["a"] @ vs["w"] + x["b"] @ vs["v"])
+
+    spec = {"a": TensorSpec((None, 4), "float32"),
+            "b": TensorSpec((None, 4), "float32")}
+    mf = ModelFunction(apply_fn, {"w": w, "v": v}, spec, name="dict_model")
+    x = {"a": np.random.default_rng(1).normal(size=(8, 4))
+         .astype(np.float32),
+         "b": np.random.default_rng(2).normal(size=(8, 4))
+         .astype(np.float32)}
+    base = mf.apply_batch(x, batch_size=8)
+    out = mf.with_dtype("bfloat16").apply_batch(x, batch_size=8)
+    np.testing.assert_allclose(out, base, atol=BF16_ATOL)
+
+
+# ---------------------------------------------------------------------------
+# EngineConfig threading through the executor choke point
+# ---------------------------------------------------------------------------
+
+
+def test_fp32_knob_bit_identical_through_executor():
+    EngineConfig.inference_precision = "float32"
+    mf = _model()
+    x = _rows(9)
+    expected = mf.apply_batch(x, batch_size=16)
+    np.testing.assert_array_equal(
+        executor.execute(mf, x, batch_size=16), expected)
+
+
+def test_bf16_knob_threads_through_executor():
+    EngineConfig.inference_precision = "bfloat16"
+    mf = _model()
+    x = _rows(9)
+    base = mf.apply_batch(x, batch_size=16)
+    out = executor.execute(mf, x, batch_size=16)
+    np.testing.assert_allclose(out, base, atol=BF16_ATOL)
+    # the executor resolved the SAME memoized precision variant (shared
+    # jit cache — no per-call recompile)
+    assert mf.with_dtype("bfloat16") in mf._precision_cache.values()
+
+
+def test_int8_knob_threads_through_executor():
+    EngineConfig.inference_precision = "int8"
+    mf = _model()
+    x = _rows(9)
+    base = mf.apply_batch(x, batch_size=16)
+    np.testing.assert_allclose(executor.execute(mf, x, batch_size=16),
+                               base, atol=INT8_ATOL)
+
+
+def test_validation_accepts_the_full_knob_matrix():
+    for precision in ("float32", "bfloat16", "int8"):
+        for donate in (True, False):
+            for ladder in ("tuned", "pow2"):
+                EngineConfig.inference_precision = precision
+                EngineConfig.inference_donate_buffers = donate
+                EngineConfig.bucket_ladder = ladder
+                EngineConfig.validate()
+
+
+# ---------------------------------------------------------------------------
+# Donated inference buffers
+# ---------------------------------------------------------------------------
+
+
+def test_donated_path_value_identical():
+    EngineConfig.inference_precision = "float32"
+    EngineConfig.inference_donate_buffers = True
+    mf = _model()
+    x = _rows(11)
+    expected = mf.apply_batch(x, batch_size=16)  # non-donated reference
+    np.testing.assert_array_equal(
+        executor.execute(mf, x, batch_size=16), expected)
+    # host numpy staging survives donation: x itself is untouched
+    np.testing.assert_array_equal(x, _rows(11))
+
+
+def test_donation_rejects_caller_reuse_of_device_buffer():
+    # shape-preserving head: the output CAN alias the input, so XLA
+    # actually consumes the donated buffer (a non-aliasable launch makes
+    # donation a safe no-op instead — see test_donated_path_value_identical)
+    def apply_fn(vs, x):
+        return jnp.tanh(x * vs)
+
+    mf = ModelFunction(apply_fn, jnp.float32(2.0),
+                       TensorSpec((None, 6), "float32"), name="alias_model")
+    x = _rows(16)
+    expected = np.asarray(mf.jitted()(x))
+    xd = jnp.asarray(x)
+    out = np.asarray(mf.jitted(donate_batch=True)(xd))
+    np.testing.assert_array_equal(out, expected)
+    # the donated device buffer is consumed by the launch — reading it
+    # afterwards is an error, not silently stale data
+    with pytest.raises(RuntimeError):
+        np.asarray(xd)
+
+
+def test_donate_apply_batch_matches_non_donated():
+    mf = _model()
+    x = _rows(33)
+    np.testing.assert_array_equal(
+        mf.apply_batch(x, batch_size=16, donate=True),
+        mf.apply_batch(x, batch_size=16))
+
+
+def test_donate_off_knob_respected():
+    EngineConfig.inference_donate_buffers = False
+    mf = _model()
+    x = _rows(5)
+    out = executor.execute(mf, x, batch_size=16)
+    np.testing.assert_array_equal(out, mf.apply_batch(x, batch_size=16))
+    # only the non-donated jit variant was built
+    assert (None, True) not in mf._jit_cache

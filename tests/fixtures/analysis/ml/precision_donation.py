@@ -1,0 +1,10 @@
+"""Seeded violation: per-call-site precision/donation decisions on the
+featurize route (executor-choke-point; the `ml/` path segment puts this
+in scope) — with_dtype and jitted(donate_batch=...) must enter through
+EngineConfig at the executor choke point, never per call site."""
+
+
+def featurize_partition(model, batch):
+    fast = model.with_dtype("bfloat16")
+    fn = fast.jitted(donate_batch=True)
+    return fn(batch)

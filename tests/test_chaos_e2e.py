@@ -346,6 +346,68 @@ def test_chaos_pipeline_with_decode_pool_bit_identical(image_dir, tmp_path):
     assert mon.count(health.DECODE_POOL_RESPAWN) == 0
 
 
+def test_chaos_pipeline_bf16_tuned_ladder_within_tolerance(image_dir,
+                                                           tmp_path):
+    """ISSUE 12 acceptance: the FULL 5-fault chaos run with the raw-speed
+    inference path armed (bfloat16 featurize + tuned bucket ladder +
+    donated buffers — the production defaults the test conftest pins
+    off) — every fault fires exactly once, recovery stays DETERMINISTIC
+    under low precision (bit-identical to the fault-free bf16 run), and
+    the features stay inside the documented bf16 envelope vs the fp32
+    fault-free truth (docs/PERF.md "Launch shaping & precision")."""
+    from sparkdl_tpu.core import batching
+
+    x_fp32, _, _, _ = _run_pipeline(image_dir, tmp_path / "fp32")
+
+    EngineConfig.inference_precision = "bfloat16"
+    EngineConfig.bucket_ladder = "tuned"
+    EngineConfig.inference_donate_buffers = True
+    batching.reset_planners()
+    try:
+        x0, y0, final0, steps0 = _run_pipeline(image_dir,
+                                               tmp_path / "plain")
+        inj = FaultInjector.seeded(
+            0,
+            decode_error=1,
+            engine_task=Fault(times=1, when=lambda c: (
+                c.get("phase") == "finish" and c["attempt"] == 0)),
+            device_oom=Fault(times=1, when=lambda c: c["rows"] >= 8),
+            transfer_stall=1,
+            preemption=Fault(when=lambda c: c["step"] == 3),
+        )
+        with inj, HealthMonitor("chaos-bf16") as mon:
+            x1, y1, final1, steps1 = _run_pipeline(image_dir,
+                                                   tmp_path / "chaos")
+    finally:
+        batching.reset_planners()
+
+    assert inj.fired == {"decode_error": 1, "engine_task": 1,
+                         "device_oom": 1, "transfer_stall": 1,
+                         "preemption": 1}
+    # fault recovery is precision-agnostic: the chaos run reproduces the
+    # fault-free bf16 run bit-for-bit (padding rows are masked out, so
+    # OOM-halved buckets and retuned rungs cannot perturb valid rows)
+    np.testing.assert_array_equal(x1, x0)
+    np.testing.assert_array_equal(y1, y0)
+    assert steps1 == steps0 == [1, 2, 3, 4, 5, 6]
+    for a, b in zip(jax.tree.leaves(final0.params),
+                    jax.tree.leaves(final1.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+    # tolerance-compared against the fp32 truth: bounded (tanh) head
+    np.testing.assert_allclose(x1, x_fp32, atol=0.05)
+    # same health counts as the fp32 chaos run — the fast path changes
+    # throughput, not the fault story
+    assert mon.count(health.DECODE_DEGRADED) == 1
+    assert mon.count(health.TASK_RETRIED) == 1
+    assert mon.count(health.OOM_RECHUNK) == 1
+    assert mon.count(health.CHUNK_RETRY) == 1
+    assert mon.count(health.GANG_RESTART) == 1
+    assert mon.count(health.FIT_RESUMED) == 1
+    assert mon.count(health.FIT_COMPLETED) == 1
+    assert mon.count(health.TASK_QUARANTINED) == 0
+
+
 def test_chaos_fatal_transform_error_retried_zero_times(image_dir):
     """Acceptance: FATAL errors are provably retried zero times, end to
     end — the engine task fails once, and the gang boundary (classify on

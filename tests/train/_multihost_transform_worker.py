@@ -32,10 +32,17 @@ import numpy as np  # noqa: E402
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))))
 
-from sparkdl_tpu.engine.dataframe import DataFrame  # noqa: E402
+from sparkdl_tpu.engine.dataframe import DataFrame, EngineConfig  # noqa: E402
 from sparkdl_tpu.image import imageIO  # noqa: E402
 from sparkdl_tpu.ml import DeepImageFeaturizer  # noqa: E402
 from sparkdl_tpu.train.runner import maybe_initialize_distributed  # noqa: E402
+
+if __name__ == "__main__":
+    # the pytest conftest pins fp32/pow2 so references stay bit-comparable;
+    # gang subprocesses never import that conftest, so mirror the pin here
+    # (the parent's single-process reference is computed under it)
+    EngineConfig.inference_precision = "float32"
+    EngineConfig.bucket_ladder = "pow2"
 
 NUM_ROWS = 16
 NUM_PARTITIONS = 4
