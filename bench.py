@@ -552,6 +552,137 @@ def bench_overload_featurize(name="EfficientNetB0", n_bulk=192,
     return results
 
 
+def bench_serving(name="EfficientNetB0", n_interactive=64,
+                  n_clients=4, n_bulk=96, bulk_partitions=4,
+                  size=(224, 224), shadow_fraction=0.25):
+    """ISSUE 13 leg: row-level interactive requests through
+    ``ModelServer.predict`` flooding beside a bulk featurize job on the
+    SAME executor (docs/SERVING.md).
+
+    One serving plane: v1 active with a latency target (so admission can
+    shed off the windowed queue-wait p99), v2 shadowed at
+    ``shadow_fraction``, both under a byte-budgeted residency manager.
+    The record carries the p50/p99 request latency, the shed rate, the
+    shadow overhead fraction (shadow device seconds per active device
+    second, from the recorded comparison events), and the cold-start
+    (eviction-then-reload) latency from the ``sparkdl.model_load``
+    path."""
+    import threading
+
+    import pyarrow as pa
+
+    from sparkdl_tpu.core import executor as device_executor
+    from sparkdl_tpu.core import health, telemetry
+    from sparkdl_tpu.core.health import HealthMonitor
+    from sparkdl_tpu.engine.dataframe import DataFrame, EngineConfig
+    from sparkdl_tpu.image import imageIO
+    from sparkdl_tpu.ml import TPUImageTransformer
+    from sparkdl_tpu.models import registry as model_registry
+    from sparkdl_tpu.serving import (ModelRegistry, ModelServer,
+                                     ResidencyManager, ServingOverloaded)
+
+    rng = np.random.default_rng(0)
+    mf_v1 = model_registry.build_featurizer(name, weights="random")
+    mf_v2 = model_registry.build_featurizer(name, weights="random")
+    budget = 4 * (mf_v1.weight_bytes() + mf_v2.weight_bytes())
+    res = ResidencyManager(budget_bytes=budget)
+    reg = ModelRegistry(residency=res)
+    srv = ModelServer(reg)
+    reg.deploy("featurizer", "v1", model=mf_v1, latency_target_ms=500.0,
+               batch_size=HEADLINE_BATCH)
+    reg.deploy("featurizer", "v2", model=mf_v2,
+               batch_size=HEADLINE_BATCH)
+    reg.shadow("featurizer", "v2", fraction=shadow_fraction)
+
+    bulk_rows = [{"image": imageIO.imageArrayToStruct(
+        rng.integers(0, 255, size=size + (3,), dtype=np.uint8))}
+        for _ in range(n_bulk)]
+    df_bulk = DataFrame.fromRows(
+        bulk_rows,
+        schema=pa.schema([pa.field("image", imageIO.imageSchema)]),
+        numPartitions=bulk_partitions)
+    # the bulk job shares the ACTIVE version's ModelFunction — one
+    # compiled fn, one executor coalescing state, so the flood and the
+    # row-level requests genuinely contend
+    t_bulk = TPUImageTransformer(inputCol="image", outputCol="features",
+                                 modelFunction=reg.model("featurizer"),
+                                 batchSize=HEADLINE_BATCH)
+    requests = rng.normal(size=(n_interactive,) + size + (3,)) \
+        .astype(np.float32)
+
+    saved = EngineConfig.snapshot()
+    try:
+        device_executor.reset()
+        srv.predict("featurizer", requests[0])  # compile v1+v2, load both
+
+        # cold start: evict the active version (unpin first — the
+        # registry pinned it) and time the reload the next request pays
+        res.pin("featurizer", "v1", False)
+        assert res.evict("featurizer", "v1")
+        res.pin("featurizer", "v1", True)
+        with HealthMonitor("serving-cold") as cold_mon:
+            srv.predict("featurizer", requests[0])
+        (cold_ev,) = cold_mon.events(health.SERVING_COLD_START)
+        cold_start_s = cold_ev["seconds"]
+
+        latencies, sheds = [], [0]
+        lat_lock = threading.Lock()
+
+        def client(cid):
+            for i in range(cid, n_interactive, n_clients):
+                try:
+                    got = srv.predict("featurizer", requests[i])
+                except ServingOverloaded:
+                    with lat_lock:
+                        sheds[0] += 1
+                    continue
+                with lat_lock:
+                    latencies.append(got.latency_s)
+
+        with telemetry.Telemetry("bench_serving") as tel:
+            with HealthMonitor("serving-flood") as mon:
+                t0 = time.perf_counter()
+                bulk = threading.Thread(
+                    target=lambda: t_bulk.transform(df_bulk)
+                    .select("features").collect())
+                clients = [threading.Thread(target=client, args=(c,))
+                           for c in range(n_clients)]
+                bulk.start()  # the flood is in the queue first
+                for th in clients:
+                    th.start()
+                for th in clients:
+                    th.join()
+                bulk.join()
+                elapsed = time.perf_counter() - t0
+            snap = tel.metrics.snapshot()
+    finally:
+        EngineConfig.restore(saved)
+        device_executor.reset()
+
+    compared = mon.events(health.SERVING_SHADOW_COMPARED)
+    shadow_s = sum(e["shadow_s"] for e in compared)
+    answered = sorted(latencies)
+    total_request_s = sum(answered)
+    return {
+        "answered": len(answered),
+        "request_p50_ms": round(
+            float(np.percentile(answered, 50)) * 1e3, 3),
+        "request_p99_ms": round(
+            float(np.percentile(answered, 99)) * 1e3, 3),
+        "shed": sheds[0],
+        "shed_rate_per_s": round(sheds[0] / elapsed, 3),
+        "shadowed_requests": len(compared),
+        # seconds spent on the shadow leg per second of total request
+        # serving — what mirroring `shadow_fraction` of traffic costs
+        "shadow_overhead_frac": round(shadow_s / total_request_s, 4)
+        if total_request_s else None,
+        "cold_start_s": round(cold_start_s, 4),
+        "cold_start_bytes": cold_ev["bytes"],
+        "request_s": _hist_summary(snap, telemetry.M_SERVING_REQUEST_S),
+        "elapsed_s": round(elapsed, 3),
+    }
+
+
 def bench_exporter_overhead(name="EfficientNetB0", n_images=128,
                             partitions=8, size=(224, 224)):
     """ISSUE 7 satellite: the periodic snapshot exporter's cost on a
@@ -1031,6 +1162,20 @@ def main():
                  "(EfficientNetB0 flood past queue bound, shed mode)",
                  ov["interactive_ips_shed_on"], "images/sec",
                  shed_on=ov["shed_on"], shed_off=ov["shed_off"])
+            # online serving plane (ISSUE 13): row-level requests beside
+            # a bulk featurize flood — request latency tail, shed rate,
+            # shadow overhead and the eviction-reload cold start
+            sv = bench_serving()
+            emit("serving request p99 ms (EfficientNetB0 row-level "
+                 "predict beside bulk flood)", sv["request_p99_ms"],
+                 "ms/step", p50_ms=sv["request_p50_ms"],
+                 answered=sv["answered"], shed=sv["shed"],
+                 shed_rate_per_s=sv["shed_rate_per_s"],
+                 shadowed_requests=sv["shadowed_requests"],
+                 shadow_overhead_frac=sv["shadow_overhead_frac"],
+                 cold_start_s=sv["cold_start_s"],
+                 cold_start_bytes=sv["cold_start_bytes"],
+                 request_s=sv["request_s"], elapsed_s=sv["elapsed_s"])
             # live observability plane (ISSUE 7): the periodic exporter's
             # cost must stay under 5% — measured on the same featurize
             # loop with the exporter on vs off

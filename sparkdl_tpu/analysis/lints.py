@@ -258,7 +258,9 @@ _DEVICE_ENTRY_ATTRS = {"apply_batch", "jitted", "with_dtype"}
 #: choke point itself (core/executor.py) and the model layer it wraps
 #: (core/model_function.py) live outside these scopes by design; the
 #: training path (train/) owns its own step programs and is exempt.
-CHOKE_SCOPES = ("ml", "udf", "engine", "image")
+#: "serving" covers the online plane (sparkdl_tpu/serving/): row-level
+#: requests enter the device ONLY via executor.execute, same as batch.
+CHOKE_SCOPES = ("ml", "udf", "engine", "image", "serving")
 
 
 def direct_device_entry_calls(tree: ast.AST) -> List[int]:

@@ -127,8 +127,28 @@ _WINDOW_MAX_S = 0.02
 _WINDOW_DEFAULT_S = 0.002
 # Idle coalescer threads exit after this long with an empty queue (and
 # restart on the next queued request), so tests and long-lived processes
-# don't accumulate one parked thread per model ever served.
+# don't accumulate one parked thread per model ever served. The live
+# value is the EngineConfig.executor_idle_retire_s knob (the serving
+# residency manager shortens it to make eviction prompt); this constant
+# is only the fallback when the engine layer isn't importable.
 _IDLE_EXIT_S = 5.0
+
+
+def _idle_exit_s() -> float:
+    """The idle-retirement interval, read from EngineConfig per use so a
+    knob flip (tests, residency manager) takes effect on parked threads
+    at their next wakeup — no service restart needed. Core must stay
+    importable without the engine, hence the lazy import."""
+    try:
+        from sparkdl_tpu.engine.dataframe import EngineConfig
+    except ImportError:  # pragma: no cover - engine always ships
+        return _IDLE_EXIT_S
+    value = getattr(EngineConfig, "executor_idle_retire_s", _IDLE_EXIT_S)
+    try:
+        value = float(value)
+    except (TypeError, ValueError):
+        return _IDLE_EXIT_S
+    return value if value > 0 else _IDLE_EXIT_S
 
 
 class ExecutorShutdown(RuntimeError):
@@ -352,6 +372,7 @@ class _FnState:
         self.latency_ewma: Optional[float] = None
         self.thread: Optional[threading.Thread] = None
         self.last_used = time.monotonic()
+        self.retired = False  # set by retire_model: exit at next wakeup
         # Circuit breaker (closed -> open -> half_open -> closed); all
         # guarded by cond. breaker_failures holds terminal-failure
         # timestamps inside the rolling window.
@@ -617,7 +638,7 @@ class DeviceExecutor:
         and self._lock must be held."""
         if (not state.pending and state.inflight == 0
                 and state.thread is None
-                and now - state.last_used >= _IDLE_EXIT_S
+                and now - state.last_used >= _idle_exit_s()
                 and self._states.get(state.key) is state):
             del self._states[state.key]
 
@@ -629,7 +650,7 @@ class DeviceExecutor:
         lock order is cond→lock, so blocking here could deadlock."""
         now = time.monotonic()
         for state in list(self._states.values()):
-            if now - state.last_used < _IDLE_EXIT_S:
+            if now - state.last_used < _idle_exit_s():
                 continue
             if not state.cond.acquire(blocking=False):
                 continue  # busy: next sweep gets it
@@ -637,6 +658,33 @@ class DeviceExecutor:
                 self._retire_locked(state, now)
             finally:
                 state.cond.release()
+
+    def retire_model(self, model: Any, variants: Optional[list] = None
+                     ) -> int:
+        """Eviction hook for the serving residency manager: drop every
+        idle coalescing state whose strong reference pins ``model`` (or
+        one of its memoized ``variants`` — precision/donation wrappers
+        are distinct compiled fns with their own states). Busy states
+        (queued or in-flight work) are skipped — their requests complete
+        normally and the idle sweep retires them afterwards; eviction
+        never tears live work. Returns the number of states dropped."""
+        idents = {id(m) for m in (variants or [model])}
+        idents.add(id(model))
+        with self._lock:
+            victims = [s for s in self._states.values()
+                       if id(s.model) in idents]
+        dropped = 0
+        for state in victims:
+            with state.cond:  # canonical lock order: cond -> lock
+                if state.pending or state.inflight:
+                    continue
+                with self._lock:
+                    if self._states.get(state.key) is state:
+                        del self._states[state.key]
+                        dropped += 1
+                state.retired = True
+                state.cond.notify_all()  # parked coalescer exits promptly
+        return dropped
 
     def _ensure_thread(self, state: _FnState) -> None:
         # caller holds state.cond
@@ -909,11 +957,14 @@ class DeviceExecutor:
             while True:
                 with state.cond:
                     idle_since = time.monotonic()
-                    while not state.pending and not self._closed:
-                        state.cond.wait(timeout=_IDLE_EXIT_S)
+                    while (not state.pending and not self._closed
+                           and not state.retired):
+                        state.cond.wait(timeout=_idle_exit_s())
+                        if state.retired:
+                            break
                         if (not state.pending and not self._closed
                                 and time.monotonic() - idle_since
-                                >= _IDLE_EXIT_S):
+                                >= _idle_exit_s()):
                             state.thread = None
                             crashed = False
                             # retire the whole state with the thread so
@@ -925,6 +976,16 @@ class DeviceExecutor:
                                                     time.monotonic())
                             return
                     if self._closed:
+                        crashed = False
+                        return
+                    if state.retired and not state.pending:
+                        # evicted via retire_model with nothing queued:
+                        # exit NOW instead of waiting out the idle
+                        # timeout, so the state's strong model reference
+                        # dies with the thread. A submit that raced the
+                        # eviction and queued anyway is drained first
+                        # (the branch above requires an empty queue).
+                        state.thread = None
                         crashed = False
                         return
                     # bounded wait window, anchored at the head request's
@@ -1295,7 +1356,8 @@ def execute(model: Any, array: Any, *, batch_size: int = 64,
             retry_policy: Optional[resilience.RetryPolicy] = None,
             prefetch: int = 2, coalesce: Optional[bool] = None,
             priority: Optional[str] = None,
-            deadline: Optional[resilience.Deadline] = None) -> Any:
+            deadline: Optional[resilience.Deadline] = None,
+            coalesce_window_ms: Optional[float] = None) -> Any:
     """THE device entry point for the inference data plane.
 
     Transformers call this instead of ``model.apply_batch`` (enforced by
@@ -1312,6 +1374,12 @@ def execute(model: Any, array: Any, *, batch_size: int = 64,
     one, which the engine supervisor threads per task) bounds queue wait
     and backpressure blocking. The admission/breaker knobs are read from
     ``EngineConfig`` per call — see the module docstring.
+
+    ``coalesce_window_ms`` overrides ``EngineConfig.coalesce_window_ms``
+    for THIS call: the serving plane's per-model SLO targets drive the
+    adaptive window through it (a tight latency target caps how long a
+    row-level request may wait for coalescing siblings). ``None`` keeps
+    the config/adaptive behavior.
     """
     # Lazy layering: core must stay importable without the engine, but the
     # coalescing knobs live with the other engine-wide knobs on
@@ -1352,7 +1420,8 @@ def execute(model: Any, array: Any, *, batch_size: int = 64,
                                  retry_policy=retry_policy,
                                  prefetch=prefetch, donate=donate,
                                  planner=planner)
-    window_ms = EngineConfig.coalesce_window_ms
+    window_ms = (coalesce_window_ms if coalesce_window_ms is not None
+                 else EngineConfig.coalesce_window_ms)
     window_s = None if window_ms is None else max(0.0, window_ms / 1e3)
     policy = (retry_policy if retry_policy is not None
               else resilience.DEFAULT_INFERENCE_POLICY)

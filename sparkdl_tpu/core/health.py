@@ -75,6 +75,19 @@ CHECKPOINT_CHECKSUM_REJECTED = "checkpoint_checksum_rejected"  # checkpoint:
                                          # restore refused a bit-rotted file
 CHECKPOINT_FENCED = "checkpoint_fenced"  # checkpoint: stale-incarnation
                                          # writer refused by fencing token
+SERVING_SHED = "serving_shed"            # serving: SLO-aware admission
+                                         # rejected a request pre-device
+SERVING_CUTOVER = "serving_cutover"      # serving: active version flipped
+                                         # (deploys AND rollbacks)
+SERVING_SHADOW_COMPARED = "serving_shadow_compared"  # serving: one shadow
+                                         # request compared vs active
+SERVING_SHADOW_ERROR = "serving_shadow_error"  # serving: shadow leg raised
+                                         # (never fails the request)
+SERVING_EVICTED = "serving_evicted"      # serving: residency dropped a
+                                         # model's weights + jit state
+SERVING_COLD_START = "serving_cold_start"  # serving: loader ran on a
+                                         # residency miss (first load OR
+                                         # reload after eviction)
 
 
 class HealthMonitor:

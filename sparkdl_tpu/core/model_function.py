@@ -485,6 +485,58 @@ class ModelFunction:
             cache[key] = self.with_preprocess(pre, input_spec=spec)
         return cache[key]
 
+    # -- residency accounting (sparkdl_tpu/serving/residency.py) -------------
+
+    def weight_bytes(self) -> int:
+        """Total bytes of the variables pytree — the HBM residency
+        manager's byte accounting for budget/eviction decisions. Counts
+        every array leaf (q8 weight dicts flatten to their int8 payload
+        plus per-channel scales, so quantized models account at their
+        real quantized size, not the float source's)."""
+        total = 0
+        for leaf in jax.tree_util.tree_leaves(self.variables):
+            nbytes = getattr(leaf, "nbytes", None)
+            if nbytes is not None:
+                total += int(nbytes)
+        return total
+
+    def device_variants(self) -> list:
+        """This model plus every memoized derived ModelFunction reachable
+        from it (precision casts, the flattener, resize wrappers —
+        transitively). The derived variants close over THIS model's
+        weights and own their own jit caches, so eviction must visit all
+        of them: clearing only the root's cache would leave a bf16
+        variant's compiled executable pinning the weights."""
+        seen: Dict[int, "ModelFunction"] = {}
+        stack: list = [self]
+        while stack:
+            m = stack.pop()
+            if id(m) in seen:
+                continue
+            seen[id(m)] = m
+            flat = getattr(m, "_flat_cache", None)
+            if flat is not None:
+                stack.append(flat)
+            stack.extend(getattr(m, "_precision_cache", {}).values())
+            stack.extend(getattr(m, "_resize_cache", {}).values())
+        return list(seen.values())
+
+    def release_device_state(self) -> None:
+        """Drop every compiled executable (jit cache) across this model
+        and its derived variants, and forget the variants themselves —
+        the eviction primitive behind the serving residency manager. The
+        weights pytree is untouched (the owner decides whether to drop
+        its reference); the next :meth:`jitted` call recompiles, which
+        is exactly the cold-start cost the ``sparkdl.model_load`` span
+        makes visible."""
+        for m in self.device_variants():
+            with m._jit_lock:
+                m._jit_cache.clear()
+        with self._jit_lock:
+            self._flat_cache = None
+            self._resize_cache.clear()
+            self._precision_cache.clear()
+
     # -- execution -----------------------------------------------------------
 
     def jitted(self, mesh=None, donate_batch: bool = False) -> Callable:

@@ -298,3 +298,47 @@ def default_rules(window_s: float = DEFAULT_WINDOW_S,
 
 
 DEFAULT_RULES: Tuple[SLORule, ...] = default_rules()
+
+# Serving-plane defaults (sparkdl_tpu/serving/, docs/SERVING.md): the
+# ModelServer's aggregate request-latency objective and its admission
+# shed rate. Per-model objectives are built from the deployment's
+# latency target at declaration time.
+DEFAULT_SERVING_P99_S = 0.5
+DEFAULT_SERVING_SHED_RATE_PER_S = 1.0
+
+
+def default_serving_rules(model_targets: Optional[Dict[str, float]] = None,
+                          window_s: float = DEFAULT_WINDOW_S,
+                          for_s: float = DEFAULT_HOLD_S,
+                          request_p99_s: float = DEFAULT_SERVING_P99_S,
+                          shed_rate_per_s: float =
+                          DEFAULT_SERVING_SHED_RATE_PER_S,
+                          ) -> Tuple[SLORule, ...]:
+    """The serving plane's rule set: the aggregate request-latency p99
+    and sustained admission shedding, plus ONE latency rule per entry of
+    ``model_targets`` (model name -> p99 target in SECONDS). Per-model
+    metrics have per-model names (metrics carry no labels), so each
+    model rule watches ``sparkdl.serving.request_s.<model>`` — declared
+    here via :func:`telemetry.declare_metric`, which is also what makes
+    ``SLORule`` construction accept the dynamic name."""
+    rules = [
+        # the latency objective: end-to-end request p99 over the window
+        SLORule("serving_request_p99",
+                metric=telemetry.M_SERVING_REQUEST_S,
+                window_s=window_s, threshold=request_p99_s,
+                comparator=">", stat="p99", for_s=for_s),
+        # the loss objective: sustained SLO-aware admission shedding
+        SLORule("serving_shed_rate",
+                metric=telemetry.HEALTH_METRIC_PREFIX
+                + health.SERVING_SHED,
+                window_s=window_s, threshold=shed_rate_per_s,
+                comparator=">=", stat="rate_per_s", for_s=for_s),
+    ]
+    for model, target_s in sorted((model_targets or {}).items()):
+        metric = telemetry.declare_metric(
+            telemetry.serving_request_metric(model), "histogram")
+        rules.append(
+            SLORule(f"serving_request_p99_{model}", metric=metric,
+                    window_s=window_s, threshold=float(target_s),
+                    comparator=">", stat="p99", for_s=for_s))
+    return tuple(rules)

@@ -104,12 +104,16 @@ SPAN_COMPILE = "sparkdl.compile"              # first launch of a new shape
 SPAN_COALESCED_LAUNCH = "sparkdl.coalesced_launch"  # core/executor.py
 SPAN_DECODE_POOL = "sparkdl.decode_pool"      # one pooled decode fan-out
                                               # (core/decode_pool.py)
+SPAN_MODEL_LOAD = "sparkdl.model_load"        # serving cold start: loader
+                                              # run on a residency miss
+                                              # (serving/residency.py)
 
 CANONICAL_SPAN_NAMES = frozenset({
     SPAN_RUN, SPAN_RUNNER_ATTEMPT, SPAN_FIT, SPAN_EPOCH,
     SPAN_CHECKPOINT_SAVE, SPAN_ESTIMATOR_FIT, SPAN_COLLECT,
     SPAN_MATERIALIZE, SPAN_TASK, SPAN_TASK_ATTEMPT,
     SPAN_COMPILE, SPAN_COALESCED_LAUNCH, SPAN_DECODE_POOL,
+    SPAN_MODEL_LOAD,
     # phase names (core/profiling.py constants + literal call sites)
     "sparkdl.decode", "sparkdl.stage", "sparkdl.stage_batch",
     "sparkdl.host_stage", "sparkdl.host_resize", "sparkdl.host_wait",
@@ -157,6 +161,17 @@ M_DECODE_POOL_DEPTH = "sparkdl.decode_pool.queue_depth"    # gauge (chunks)
 M_DECODE_POOL_BUSY = "sparkdl.decode_pool.workers_busy"    # gauge
 M_DECODE_POOL_DECODE_S = "sparkdl.decode_pool.decode_s"    # histogram
                                                            # (per blob)
+# Online serving plane (sparkdl_tpu/serving/, docs/SERVING.md): row-level
+# request path over the executor choke point. Per-model latency
+# histograms are declared dynamically at deploy time as
+# "sparkdl.serving.request_s.<model>" via declare_metric().
+M_SERVING_REQUEST_S = "sparkdl.serving.request_s"      # histogram (e2e)
+M_SERVING_QUEUE_DEPTH = "sparkdl.serving.queue_depth"  # gauge (in-flight
+                                                       # predict calls)
+M_SERVING_SHADOW_DIVERGENCE = "sparkdl.serving.shadow_divergence"
+                                                       # histogram (max
+                                                       # |active-shadow|)
+M_SERVING_EVICTIONS = "sparkdl.serving.evictions"      # counter
 HEALTH_METRIC_PREFIX = "sparkdl.health."
 
 # Instrument kind per canonical metric — machine-readable so core/slo.py
@@ -188,9 +203,48 @@ CANONICAL_METRIC_KINDS: Dict[str, str] = {
     M_DECODE_POOL_DEPTH: "gauge",
     M_DECODE_POOL_BUSY: "gauge",
     M_DECODE_POOL_DECODE_S: "histogram",
+    M_SERVING_REQUEST_S: "histogram",
+    M_SERVING_QUEUE_DEPTH: "gauge",
+    M_SERVING_SHADOW_DIVERGENCE: "histogram",
+    M_SERVING_EVICTIONS: "counter",
 }
 
 CANONICAL_METRIC_NAMES = frozenset(CANONICAL_METRIC_KINDS)
+
+_declare_lock = threading.Lock()
+
+
+def declare_metric(name: str, kind: str) -> str:
+    """Declare a DYNAMIC metric name (e.g. the per-model serving latency
+    histogram ``sparkdl.serving.request_s.<model>``) into the catalog so
+    ``core.slo.SLORule`` construction accepts it. Static call sites must
+    use the ``M_*`` constants — this is for names that only exist at
+    runtime (model deployments). Idempotent; re-declaring with a
+    DIFFERENT kind raises (two writers disagreeing on the instrument
+    would corrupt every rule watching it). Returns ``name``."""
+    if kind not in ("histogram", "counter", "gauge"):
+        raise ValueError(
+            f"declare_metric kind must be 'histogram', 'counter' or "
+            f"'gauge', got {kind!r}")
+    global CANONICAL_METRIC_NAMES
+    with _declare_lock:
+        have = CANONICAL_METRIC_KINDS.get(name)
+        if have is not None and have != kind:
+            raise ValueError(
+                f"metric {name!r} already declared as {have!r}, cannot "
+                f"re-declare as {kind!r}")
+        if have is None:
+            CANONICAL_METRIC_KINDS[name] = kind
+            CANONICAL_METRIC_NAMES = frozenset(CANONICAL_METRIC_KINDS)
+    return name
+
+
+def serving_request_metric(model: str) -> str:
+    """The per-model serving latency histogram name. Metrics carry no
+    labels, so per-model p99 objectives get per-model NAMES — declared
+    at deploy time (``declare_metric``), observed by the ModelServer
+    beside the aggregate ``M_SERVING_REQUEST_S``."""
+    return M_SERVING_REQUEST_S + "." + model
 
 # ---------------------------------------------------------------------------
 # Span tracing

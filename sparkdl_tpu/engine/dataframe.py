@@ -126,6 +126,12 @@ class EngineConfig:
     executor_breaker_threshold: int = 0
     executor_breaker_window_s: float = 30.0
     executor_breaker_cooldown_s: float = 1.0
+    # Idle coalescing-state retirement: a model's compiled-fn state (and
+    # the strong reference pinning its weights) is dropped after this
+    # many seconds without a request. The serving residency manager
+    # (sparkdl_tpu/serving/residency.py) and tests lower it to make
+    # eviction prompt; 5 s is the historical hard-coded value.
+    executor_idle_retire_s: float = 5.0
     # -- parallel host decode pool (core/decode_pool.py, docs/PERF.md
     # "Parallel host ingest") --------------------------------------------------
     # Spawn-context worker PROCESSES for the image-decode fan-out (JPEG
@@ -198,7 +204,8 @@ class EngineConfig:
                  cls.executor_default_priority,
                  cls.executor_breaker_threshold,
                  cls.executor_breaker_window_s,
-                 cls.executor_breaker_cooldown_s, cls.decode_workers,
+                 cls.executor_breaker_cooldown_s,
+                 cls.executor_idle_retire_s, cls.decode_workers,
                  cls.decode_pool_inflight, cls.durable_dir, cls.max_workers)
         if knobs == cls._validated_knobs:
             return
@@ -268,6 +275,8 @@ class EngineConfig:
         positive("executor_breaker_window_s", cls.executor_breaker_window_s)
         positive("executor_breaker_cooldown_s",
                  cls.executor_breaker_cooldown_s, exclusive=False)
+        positive("executor_idle_retire_s", cls.executor_idle_retire_s,
+                 allow_none=False)
         if cls.decode_workers < 0:
             raise ValueError(
                 "EngineConfig.decode_workers must be >= 0 (0 disables "

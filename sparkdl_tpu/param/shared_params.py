@@ -151,18 +151,28 @@ class HasMesh(Params):
 
 class HasModelFunction(Params):
     """The rebuild's analog of the reference's ``tfInputGraph``/Keras-model
-    params: a :class:`sparkdl_tpu.core.model_function.ModelFunction`."""
+    params: a :class:`sparkdl_tpu.core.model_function.ModelFunction` —
+    or a served model NAME (str), resolved through the process-wide
+    serving registry at each transform call, so batch transformers
+    follow deployments/cutovers/rollbacks like online requests do."""
 
     modelFunction = Param(
         "HasModelFunction", "modelFunction",
-        "ModelFunction to apply (pure apply fn + params pytree + input spec)",
+        "ModelFunction to apply (pure apply fn + params pytree + input "
+        "spec), or the name of a serving-registry deployment",
         typeConverter=SparkDLTypeConverters.toModelFunction)
 
     def setModelFunction(self, value: Any) -> "HasModelFunction":
         return self._set(modelFunction=value)
 
     def getModelFunction(self):
-        return self.getOrDefault(self.modelFunction)
+        value = self.getOrDefault(self.modelFunction)
+        if isinstance(value, str):
+            # lazy import: param must stay importable without serving
+            from sparkdl_tpu.serving.registry import default_registry
+
+            return default_registry().model(value)
+        return value
 
 
 class HasInputDType(Params):

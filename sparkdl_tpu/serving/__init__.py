@@ -1,0 +1,37 @@
+"""Online serving plane (ROADMAP items 1 + 5, docs/SERVING.md).
+
+The row-level front-end over the executor choke point: a
+:class:`~sparkdl_tpu.serving.server.ModelServer` serves single rows and
+small batches through ``core.executor.execute`` with SLO-aware
+admission; a :class:`~sparkdl_tpu.serving.registry.ModelRegistry` holds
+versioned deployments with shadow traffic, atomic cutover and rollback;
+a :class:`~sparkdl_tpu.serving.residency.ResidencyManager` keeps many
+models resident under a byte-accounted HBM budget with LRU/weighted
+eviction, pinning, and ``sparkdl.model_load`` cold-start spans.
+"""
+
+from sparkdl_tpu.serving.registry import (  # noqa: F401
+    Deployment,
+    ModelRegistry,
+    default_registry,
+)
+from sparkdl_tpu.serving.residency import (  # noqa: F401
+    ResidencyExhausted,
+    ResidencyManager,
+)
+from sparkdl_tpu.serving.server import (  # noqa: F401
+    ModelServer,
+    PredictResult,
+    ServingOverloaded,
+)
+
+__all__ = [
+    "Deployment",
+    "ModelRegistry",
+    "ModelServer",
+    "PredictResult",
+    "ResidencyExhausted",
+    "ResidencyManager",
+    "ServingOverloaded",
+    "default_registry",
+]

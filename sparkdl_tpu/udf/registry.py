@@ -106,6 +106,9 @@ def registerTensorUDF(name: str, modelFunction, batchSize: int = 64,
                       registry: Optional[UDFRegistry] = None) -> ColumnUDF:
     """Register a ModelFunction over numeric columns under ``name``.
 
+    ``modelFunction`` may also be a serving-registry deployment name
+    (str): the UDF then resolves the ACTIVE version per transform call,
+    so SQL-surface model calls follow hot-swaps and rollbacks.
     ``mesh``: optional jax.sharding.Mesh for multi-chip serving (falls back
     to the framework default mesh when None).
     """
@@ -127,6 +130,8 @@ def registerImageUDF(name: str, modelFunction, batchSize: int = 64,
                      registry: Optional[UDFRegistry] = None) -> ColumnUDF:
     """Register a ModelFunction over image-struct columns under ``name``.
 
+    ``modelFunction`` may also be a serving-registry deployment name
+    (str), resolved to the active version per transform call.
     ``preprocessor`` (optional): host-side ``HWC ndarray -> HWC ndarray``
     applied per image before staging — the analog of the reference's
     preprocessor graph piece composed in front of the model (§3.4).

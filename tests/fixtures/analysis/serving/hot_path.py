@@ -1,0 +1,8 @@
+"""Seeded violation: the online serving plane bypassing the executor
+(executor-choke-point; the `serving/` path segment puts this in scope —
+a ModelServer launching via apply_batch would silently lose coalescing,
+priority lanes, admission control and the breaker for online traffic)."""
+
+
+def predict_row(model, row):
+    return model.apply_batch(row[None], batch_size=1)

@@ -78,6 +78,7 @@ EXPECTED_FIXTURE_RULES = {
     "broad_retry.py": {"broad-retry"},
     "ml/choke_point.py": {"executor-choke-point"},
     "ml/precision_donation.py": {"executor-choke-point"},
+    "serving/hot_path.py": {"executor-choke-point"},
     "trainer_fetch.py": {"blocking-fetch-in-fit"},
     "span_name_typo.py": {"span-names"},
     "health_bare_string.py": {"health-constants"},

@@ -803,3 +803,158 @@ def test_chaos_overload_slo_timeline_breach_and_recovery(tmp_path):
     assert report["timeline"]["snapshots"] == len(lines)
     assert any(e.get("slo_breached") == ["executor_shed_rate"]
                for e in report["timeline"]["entries"])
+
+
+def _scaled_feature_model(scale: float, name: str) -> ModelFunction:
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=(8 * 8 * 3, _FEATURES))
+                    .astype(np.float32) * 0.01 * scale)
+    return ModelFunction(
+        lambda vs, x: jnp.tanh(x.reshape((x.shape[0], -1)) @ vs),
+        w, TensorSpec((None, 8, 8, 3), "float32"), name=name)
+
+
+def _run_serving_pipeline(image_dir, ckpt_dir):
+    """ISSUE 13 chaos leg: the SAME files→decode→infer→fit shape as
+    _run_pipeline, with the inference stage served ONLINE — a
+    sequential stream of row-level ModelServer.predict requests with a
+    v1→v2 hot-swap armed at a FIXED request index (and v2 shadowing at
+    0.5 before the swap). Sequential requests + the deterministic
+    shadow accumulator make the swap point, the shadow set and every
+    output reproducible across runs. Returns (outputs, versions,
+    final_state, steps_run)."""
+    from sparkdl_tpu.serving import ModelRegistry, ModelServer
+
+    # decode stage: one partition task, same fault surface as the
+    # engine pipeline (decode_error degrades a row; engine_task kills
+    # the attempt after compute; the classified retry re-decodes)
+    df = imageIO.readImages(str(image_dir), numPartition=1)
+    df = df.withColumn(
+        "label", lambda p: int(re.search(r"img_(\d+)", p).group(1)) % 2,
+        ["filePath"], pa.int64())
+    rows = df.select("image", "label").collect()
+    x = np.stack([imageIO.imageStructToArray(r["image"]).astype(np.float32)
+                  for r in rows])
+    y = np.eye(2, dtype=np.float32)[[r["label"] for r in rows]]
+
+    # serving stage: v1 active, v2 shadowed at 0.5 — 6 requests of 12
+    # rows each (>= 8-row launches so device_oom/transfer_stall hit the
+    # serving path), hot-swap to v2 before request index 3
+    reg = ModelRegistry()
+    srv = ModelServer(reg)
+    reg.deploy("chaos_served", "v1",
+               model=_scaled_feature_model(1.0, "chaos_v1"),
+               batch_size=8)
+    reg.deploy("chaos_served", "v2",
+               model=_scaled_feature_model(2.0, "chaos_v2"),
+               batch_size=8)
+    reg.shadow("chaos_served", "v2", fraction=0.5)
+    outputs, versions = [], []
+    for i in range(6):
+        if i == 3:
+            reg.cutover("chaos_served", "v2")  # mid-stream hot-swap
+        got = srv.predict("chaos_served", x)
+        outputs.append(np.asarray(got.output))
+        versions.append(got.version)
+
+    # fit stage on the v1-served features (identical across runs): the
+    # gang preemption + checkpoint resume ride along unchanged
+    feats = outputs[0]
+    batches = [(feats[i:i + 4], y[i:i + 4])
+               for i in range(0, _N_IMAGES, 4)]
+    steps_run = []
+
+    def train_fn(mesh=None):
+        trainer, state = Trainer.from_flax(_MODULE, _VARIABLES,
+                                           optimizer="sgd",
+                                           learning_rate=0.1, mesh=mesh)
+        ckpt = CheckpointManager(str(ckpt_dir))
+        state = trainer.fit(state, batches, epochs=2, checkpoint=ckpt,
+                            checkpoint_every=1, on_step=steps_run.append)
+        ckpt.wait_until_finished()
+        ckpt.close()
+        return jax.device_get(state)
+
+    final = TPURunner(np=2, max_restarts=2).run(train_fn)
+    return outputs, versions, final, steps_run
+
+
+def test_chaos_serving_hot_swap_bit_identical(image_dir, tmp_path):
+    """ISSUE 13 satellite: the 5-fault chaos composition through
+    ModelServer.predict with a mid-stream v1→v2 hot-swap armed — zero
+    dropped requests, per-version outputs bit-identical to the
+    fault-free swap run, and serving/fit health counts equal to the
+    fault-free swap run (the faults add ONLY their recovery events)."""
+    from sparkdl_tpu.core import executor as device_executor
+
+    with HealthMonitor("serving-plain") as mon0:
+        out0, ver0, final0, steps0 = _run_serving_pipeline(
+            image_dir, tmp_path / "plain")
+    device_executor.reset()  # a fresh service for the chaos run
+
+    inj = FaultInjector.seeded(
+        0,
+        decode_error=1,
+        engine_task=Fault(times=1, when=lambda c: (
+            c.get("phase") == "finish" and c["attempt"] == 0)),
+        # the serving launches are 12-row batches chunked at 8: the OOM
+        # halves the serving chunk, the stall retries it — both INSIDE
+        # a predict call
+        device_oom=Fault(times=1, when=lambda c: c["rows"] >= 8),
+        transfer_stall=1,
+        preemption=Fault(when=lambda c: c["step"] == 3),
+    )
+    try:
+        with inj, HealthMonitor("serving-chaos") as mon:
+            out1, ver1, final1, steps1 = _run_serving_pipeline(
+                image_dir, tmp_path / "chaos")
+    finally:
+        device_executor.reset()
+
+    # every armed fault actually fired, exactly once
+    assert inj.fired == {"decode_error": 1, "engine_task": 1,
+                         "device_oom": 1, "transfer_stall": 1,
+                         "preemption": 1}
+
+    # zero dropped / double-served: 6 answers, one per request, with
+    # the swap landing at the same fixed index in both runs
+    assert len(out1) == len(out0) == 6
+    assert ver1 == ver0 == ["v1", "v1", "v1", "v2", "v2", "v2"]
+    # per-version outputs bit-identical to the fault-free swap run
+    for a, b in zip(out1, out0):
+        np.testing.assert_array_equal(a, b)
+    # and the two versions genuinely disagree (the swap is observable)
+    assert not np.array_equal(out1[0], out1[3])
+
+    # the fit leg resumed to the same result
+    assert steps1 == steps0 == [1, 2, 3, 4, 5, 6]
+    for a, b in zip(jax.tree.leaves(final0.params),
+                    jax.tree.leaves(final1.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+
+    # serving + fit health counts EQUAL to the fault-free swap run:
+    # one cutover, the same deterministic shadow set (requests 1 only:
+    # 0.5 accumulates to a fire every 2nd pre-swap request), the same
+    # per-version cold starts, one completed fit
+    for event in (health.SERVING_CUTOVER, health.SERVING_SHADOW_COMPARED,
+                  health.SERVING_COLD_START, health.SERVING_SHED,
+                  health.SERVING_SHADOW_ERROR, health.FIT_COMPLETED):
+        assert mon.count(event) == mon0.count(event), event
+    assert mon.count(health.SERVING_CUTOVER) == 1
+    assert mon.count(health.SERVING_SHADOW_COMPARED) == 1
+    assert mon.count(health.SERVING_COLD_START) == 2  # v1 + v2, once
+    assert mon.count(health.SERVING_SHED) == 0
+
+    # the faults added ONLY their recovery events
+    assert mon.count(health.DECODE_DEGRADED) == 1
+    assert mon.count(health.TASK_RETRIED) == 1
+    assert mon.count(health.OOM_RECHUNK) == 1
+    assert mon.count(health.CHUNK_RETRY) == 1
+    assert mon.count(health.GANG_RESTART) == 1
+    assert mon.count(health.FIT_RESUMED) == 1
+    assert mon.count(health.TASK_QUARANTINED) == 0
+    assert mon0.count(health.OOM_RECHUNK) == 0
+    assert mon0.count(health.GANG_RESTART) == 0
