@@ -778,6 +778,84 @@ def bench_durable_ingest(n_images=256):
             1 - ips_on / max(ips_off, 1e-9))
 
 
+def bench_cluster_featurize(name="EfficientNetB0", n_images=256,
+                            workers=2):
+    """ISSUE 14 satellite: the e2e files→readImages→featurize pipeline
+    in-process (cluster_workers=0) vs fanned across the cluster plane
+    (cluster_workers=2) in ONE record.
+
+    Beyond the rate pair, the record carries what only the merged
+    cross-worker report can show: per-worker phase breakdowns (each
+    worker's ``profiling.phase_stats`` from its end-of-run snapshot),
+    the rows-per-worker balance the load-aware dispatch produced, and
+    the router overhead fraction — 1 − (worker-measured op-chain
+    seconds / coordinator-measured dispatch wall seconds), i.e. the
+    share of dispatch time spent on transport + routing rather than
+    executing the chain."""
+    import jax.numpy as jnp
+
+    from sparkdl_tpu.cluster import router as cluster_router
+    from sparkdl_tpu.engine.dataframe import EngineConfig
+    from sparkdl_tpu.image.imageIO import readImages
+    from sparkdl_tpu.ml import DeepImageFeaturizer
+
+    rng = np.random.default_rng(0)
+    saved = EngineConfig.snapshot()
+    results = {}
+    report = None
+    router_stats = {}
+    try:
+        with tempfile.TemporaryDirectory() as d:
+            _write_jpegs(d, n_images, rng)
+            t = DeepImageFeaturizer(inputCol="image", outputCol="features",
+                                    modelName=name,
+                                    batchSize=HEADLINE_BATCH,
+                                    dtype=jnp.bfloat16, weights="random")
+
+            def run():
+                df = readImages(d, numPartition=4)
+                out = t.transform(df).select("features").collect()
+                assert len(out) == n_images
+
+            run()  # warmup: compile + host caches (cluster off)
+            for mode, n_workers in (("cluster_off", 0),
+                                    ("cluster_on", workers)):
+                EngineConfig.cluster_workers = n_workers
+                if n_workers:
+                    run()  # warmup the workers: spawn + per-worker compile
+                best, spread = _best_of(run)
+                results[mode] = (n_images / best, spread)
+            # measured-window router accounting: totals accumulate from
+            # the warmup on, so take the live router's view before close
+            router = cluster_router.maybe_router()
+            router_stats = {
+                "dispatch_s": router.dispatch_s_total,
+                "exec_s": router.exec_s_total,
+            }
+    finally:
+        EngineConfig.restore(saved)
+        cluster_router.shutdown()
+    report = cluster_router.last_cluster_report() or {}
+    ips_on, sp_on = results["cluster_on"]
+    ips_off, sp_off = results["cluster_off"]
+    dispatch_s = router_stats.get("dispatch_s", 0.0)
+    overhead = 1 - router_stats.get("exec_s", 0.0) / max(dispatch_s, 1e-9)
+    worker_phases = {
+        w: {phase: round(s.get("total_s", 0.0), 3)
+            for phase, s in (snap.get("phases") or {}).items()}
+        for w, snap in (report.get("workers") or {}).items()}
+    return {
+        "ips_on": ips_on, "sp_on": sp_on,
+        "ips_off": ips_off, "sp_off": sp_off,
+        "workers": workers,
+        "router_overhead_frac": overhead,
+        "rows_per_worker": report.get("rows_per_worker", {}),
+        "exec_s_per_worker": report.get("exec_s_per_worker", {}),
+        "worker_phases": worker_phases,
+        "health_consistent": report.get("health_consistent"),
+    }
+
+
 def bench_precision_featurize(name="EfficientNetB0", n_images=128,
                               size=(224, 224), batch_size=64):
     """ISSUE 12 satellite: fp32 / bf16 / int8 featurize throughput AND
@@ -1198,6 +1276,22 @@ def main():
                  durable_off=round(dips_off, 2),
                  durable_off_spread=round(dsp_off, 4),
                  overhead_frac=round(dfrac, 4))
+            # cluster inference plane (ISSUE 14): the same e2e featurize
+            # fanned across 2 worker processes vs in-process — rate
+            # pair, per-worker phase breakdowns from the merged report,
+            # dispatch balance, and the router's transport overhead
+            cl = bench_cluster_featurize()
+            emit("cluster featurize e2e images/sec (files->readImages->"
+                 "EfficientNetB0 featurize, 2 workers)", cl["ips_on"],
+                 "images/sec", spread=round(cl["sp_on"], 4),
+                 cluster_off=round(cl["ips_off"], 2),
+                 cluster_off_spread=round(cl["sp_off"], 4),
+                 cluster_workers=cl["workers"],
+                 router_overhead_frac=round(cl["router_overhead_frac"], 4),
+                 rows_per_worker=cl["rows_per_worker"],
+                 exec_s_per_worker=cl["exec_s_per_worker"],
+                 worker_phases=cl["worker_phases"],
+                 health_consistent=cl["health_consistent"])
 
             # raw-speed inference (ISSUE 12): the precision ladder —
             # fp32/bf16/int8 throughput AND max output delta, one record

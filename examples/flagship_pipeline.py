@@ -83,6 +83,25 @@ def main() -> None:
         print(f"SQL serving: {len(served)} rows, "
               f"{len(served[0]['features'])}-dim features")
 
+        # 5. cluster inference plane (docs/DISTRIBUTED.md "Cluster
+        #    inference"): the same transform fanned across 2 worker
+        #    processes — bit-identical output, one merged report
+        from sparkdl_tpu.cluster import router as cluster_router
+        from sparkdl_tpu.engine import EngineConfig
+
+        EngineConfig.cluster_workers = 2
+        try:
+            fanned = model.transform(df).collect()
+        finally:
+            EngineConfig.cluster_workers = 0
+            cluster_router.shutdown()  # workers ship their snapshots here
+        assert [r["prediction"] for r in fanned] \
+            == [r["prediction"] for r in scored]
+        report = cluster_router.last_cluster_report()
+        print(f"cluster: {report['worker_count']} workers, "
+              f"rows/worker {report['rows_per_worker']}, "
+              f"health_consistent={report['health_consistent']}")
+
 
 if __name__ == "__main__":
     main()

@@ -260,7 +260,11 @@ _DEVICE_ENTRY_ATTRS = {"apply_batch", "jitted", "with_dtype"}
 #: training path (train/) owns its own step programs and is exempt.
 #: "serving" covers the online plane (sparkdl_tpu/serving/): row-level
 #: requests enter the device ONLY via executor.execute, same as batch.
-CHOKE_SCOPES = ("ml", "udf", "engine", "image", "serving")
+#: "cluster" covers the multi-process inference plane
+#: (sparkdl_tpu/cluster/): a worker's op chain reaches the device via
+#: its per-process executor — router/worker code never launches
+#: directly.
+CHOKE_SCOPES = ("ml", "udf", "engine", "image", "serving", "cluster")
 
 
 def direct_device_entry_calls(tree: ast.AST) -> List[int]:

@@ -1,0 +1,27 @@
+"""Cluster inference plane: one engine job across N worker processes.
+
+The reference scaled inference horizontally through Spark executors;
+this package is that story rebuilt for the TPU-native engine. Three
+modules:
+
+- ``cluster/worker.py`` — spawn-context worker process hosting a full
+  per-process stack (device runtime, ``DeviceExecutor`` + compiled-fn
+  cache, ``Telemetry(run_id=...)`` pinned to the coordinator's run id).
+- ``cluster/router.py`` — load-aware partition router for
+  ``engine/dataframe.py`` materialize/stream, routed THROUGH the
+  existing supervisor so deadlines, classified retry, hedging, and
+  quarantine survive the process boundary; precise re-dispatch on
+  worker death.
+- ``cluster/aggregate.py`` — merges per-worker end-of-run snapshots
+  into ONE ``RunReport`` ``cluster`` section.
+
+Gated behind ``EngineConfig.cluster_workers`` (default 0 = in-process
+path, byte-identical; this package is never imported). Deliberately no
+eager submodule imports here: the gate in ``engine/dataframe.py`` must
+stay the only importer, and a spawned worker reaching
+``cluster.worker`` must not drag the router (or jax) into its boot.
+
+Docs: docs/DISTRIBUTED.md "Cluster inference".
+"""
+
+__all__ = ["aggregate", "router", "worker"]

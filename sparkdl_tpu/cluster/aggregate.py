@@ -1,0 +1,127 @@
+"""Cross-worker observability merge for the cluster inference plane.
+
+A cluster run is N worker processes, each with its OWN telemetry scope
+(``Telemetry(run_id=...)`` pinned to the coordinator's run id) and its
+own :class:`~sparkdl_tpu.core.health.HealthMonitor`. Without a merge
+step, the operator story regresses to N disjoint black boxes — the
+exact failure mode the single-process ``RunReport`` was built to
+prevent. This module is the merge step: each worker builds ONE
+end-of-run snapshot (:func:`build_snapshot`, shipped over its private
+result pipe as the last message before EOF) and the coordinator folds
+the snapshots into a single ``cluster`` section
+(:func:`merge_snapshots`) or a full merged run report
+(:func:`merged_run_report`).
+
+Two accounting paths exist for health counts — the worker's monitor
+counters and the ``sparkdl.health.<event>`` metric mirrors
+:func:`sparkdl_tpu.core.health.record` writes through one choke point —
+and the merge cross-checks them (``health_consistent``): equality is
+*proven* per merge, not assumed, so a divergence (a worker recording
+outside its scopes) is visible in the report instead of silently
+producing two different truths.
+
+Stdlib + ``core.telemetry`` only — importable from a freshly spawned
+worker without dragging in jax.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+from sparkdl_tpu.core import telemetry
+
+__all__ = ["build_snapshot", "merge_snapshots", "merged_run_report",
+           "sum_canonical_counters", "sum_health_counters"]
+
+
+def build_snapshot(worker: str, pid: int, tel: Any, monitor: Any, *,
+                   tasks: int, rows: int, exec_s: float,
+                   phases: Optional[Dict[str, Any]] = None
+                   ) -> Dict[str, Any]:
+    """One worker's end-of-run snapshot (worker-side, while its
+    telemetry scope and health monitor are still active): the same
+    ingredients ``RunReport.build`` uses, JSON-able, small enough to
+    ship over the result pipe."""
+    return {
+        "worker": worker,
+        "pid": pid,
+        "run_id": tel.run_id,
+        "tasks": tasks,
+        "rows": rows,
+        "exec_s": round(exec_s, 6),
+        "metrics": tel.metrics.snapshot(),
+        "health": monitor.report(),
+        "trace": tel.tracer.summary(),
+        "phases": dict(phases or {}),
+    }
+
+
+def sum_canonical_counters(snapshots: Sequence[Dict[str, Any]]
+                           ) -> Dict[str, float]:
+    """Sum each worker's counter metrics, restricted to the canonical
+    catalog plus the ``sparkdl.health.*`` mirrors — ad-hoc counters stay
+    in the per-worker sections, so the cluster-wide totals only ever
+    contain names the taxonomy lint enforces."""
+    totals: Dict[str, float] = {}
+    for snap in snapshots:
+        counters = (snap.get("metrics") or {}).get("counters") or {}
+        for name, value in counters.items():
+            if (name in telemetry.CANONICAL_METRIC_NAMES
+                    or name.startswith(telemetry.HEALTH_METRIC_PREFIX)):
+                totals[name] = totals.get(name, 0) + value
+    return dict(sorted(totals.items()))
+
+
+def sum_health_counters(snapshots: Sequence[Dict[str, Any]]
+                        ) -> Dict[str, int]:
+    """Sum the worker HealthMonitor counters across snapshots — the
+    monitor-side accounting path, kept independent of the metric
+    mirrors so :func:`merge_snapshots` can cross-check the two."""
+    totals: Dict[str, int] = {}
+    for snap in snapshots:
+        counters = (snap.get("health") or {}).get("counters") or {}
+        for name, value in counters.items():
+            totals[name] = totals.get(name, 0) + value
+    return dict(sorted(totals.items()))
+
+
+def merge_snapshots(snapshots: Sequence[Dict[str, Any]]
+                    ) -> Dict[str, Any]:
+    """Fold per-worker snapshots into ONE ``cluster`` report section.
+
+    Per-worker sections survive verbatim under ``workers`` (debugging a
+    sick worker needs its un-summed view), canonical counters are
+    summed cluster-wide, and the merged health counters are the sum of
+    the worker monitors — with ``health_consistent`` proving that sum
+    equals the independently-accumulated ``sparkdl.health.*`` metric
+    mirrors, event for event.
+    """
+    snapshots = [s for s in snapshots if s]
+    health_totals = sum_health_counters(snapshots)
+    counters = sum_canonical_counters(snapshots)
+    prefix = telemetry.HEALTH_METRIC_PREFIX
+    mirrored = {name[len(prefix):]: int(value)
+                for name, value in counters.items()
+                if name.startswith(prefix)}
+    return {
+        "worker_count": len(snapshots),
+        "workers": {s["worker"]: s for s in snapshots},
+        "counters": counters,
+        "health": {"counters": health_totals},
+        "health_consistent": mirrored == health_totals,
+        "tasks_per_worker": {s["worker"]: s.get("tasks", 0)
+                             for s in snapshots},
+        "rows_per_worker": {s["worker"]: s.get("rows", 0)
+                            for s in snapshots},
+        "exec_s_per_worker": {s["worker"]: s.get("exec_s", 0.0)
+                              for s in snapshots},
+    }
+
+
+def merged_run_report(tel: Any, snapshots: Sequence[Dict[str, Any]],
+                      health_monitor: Any = None) -> Dict[str, Any]:
+    """The coordinator's normal ``RunReport`` plus the merged
+    ``cluster`` section — one artifact for the whole cluster run."""
+    report = telemetry.RunReport.build(tel, health_monitor)
+    report["cluster"] = merge_snapshots(snapshots)
+    return report

@@ -1,0 +1,689 @@
+"""Partition router: one engine job fanned across N worker processes.
+
+``engine/dataframe.py`` swaps its in-process ``_run_partition`` for
+:meth:`ClusterRouter.run_partition` when ``EngineConfig.cluster_workers``
+is set (the ONE knob; 0 keeps today's path byte-identical and never
+imports this package). The router deliberately routes **through the
+existing supervisor** — each partition still runs under
+``engine/supervisor.py``'s classified retry, per-task deadline,
+hedging, and quarantine; only the innermost "run the op chain" step is
+replaced by a remote dispatch. That preserves every resilience
+semantic across the process boundary for free:
+
+- **retry**: a worker-side exception ships back typed with its
+  ``resilience.classify`` kind and re-raises in the coordinator's
+  retry loop — a retried attempt re-enters :meth:`run_partition`'s
+  dispatch and picks a worker afresh.
+- **hedging**: a hedge is just a second supervisor attempt; dispatch
+  excludes workers already holding an in-flight attempt of the same
+  partition, so the hedge lands on a *different* worker (a straggling
+  worker cannot slow its own hedge).
+- **quarantine**: FATAL confirmation replays route through dispatch
+  like any retry; the partition-drop decision stays coordinator-side.
+- **deadlines**: the supervisor watchdog's ``cancelled`` event makes
+  the coordinator-side wait abandon (the worker's result, if it ever
+  arrives, is dropped by the collector as an already-resolved task).
+
+Assignment is load-aware on **outstanding rows** per worker (ties:
+fewest in-flight tasks), the cluster analogue of the decode pool's
+least-loaded pick but weighted by actual row counts so one huge
+partition doesn't get a second one stacked behind it.
+
+Worker death is detected as EOF on the dead worker's PRIVATE result
+pipe (one writer per pipe — the decode-pool transport rationale). The
+loss set is precise: exactly the dead worker's in-flight task ids,
+re-dispatched to survivors (each re-dispatch is a
+``cluster_redispatch`` health event + ``sparkdl.cluster.redispatch``
+count; the death itself is ONE ``cluster_worker_lost``). With no
+survivors the in-flight partitions fail with
+:class:`~sparkdl_tpu.core.resilience.ClusterWorkerLost` — classified
+RETRYABLE, so the supervisor's task retry re-dispatches once workers
+are back (or fails the job with the full attempt history). With
+``EngineConfig.durable_dir`` set, the PR 11 journal wraps OUTSIDE this
+router (``dataframe._durable_runner``), so partitions committed before
+a death are never re-dispatched at all — re-dispatch is zero-recompute
+for them by construction.
+
+At :meth:`close`, each worker ships its end-of-run snapshot
+(``cluster/worker.py`` protocol), and the router merges them via
+``cluster/aggregate.py`` into :attr:`cluster_report` (plus
+:attr:`run_report` when a telemetry scope is active) — module-level
+:func:`last_cluster_report` / :func:`last_run_report` keep the merged
+view readable after :func:`shutdown`.
+"""
+
+from __future__ import annotations
+
+import atexit
+import hashlib
+import itertools
+import logging
+import multiprocessing as mp
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+from sparkdl_tpu.cluster import aggregate
+from sparkdl_tpu.cluster import worker as _worker_mod
+from sparkdl_tpu.core import durability, health, resilience, telemetry
+
+logger = logging.getLogger(__name__)
+
+# One spawn context for every router (module-level so the
+# thread-lifecycle analyzer rule can resolve `_MP_CTX.Process(...)`).
+_MP_CTX = mp.get_context("spawn")
+
+# Waiter/submitter poll granularity (bounds close/cancel detection
+# latency) and worker join budget at close.
+_WAIT_POLL_S = 0.05
+_JOIN_TIMEOUT_S = 10.0
+
+_run_ids = itertools.count(1)
+
+
+def _rebuild_error(type_name: str, msg: str, kind: str) -> BaseException:
+    """Reconstruct a worker-side exception coordinator-side, preserving
+    classification exactly: prefer the original type (builtin, then a
+    ``resilience`` class) — but only if the rebuilt instance still
+    classifies to the kind the worker computed; otherwise fall back to
+    a RuntimeError carrying ``failure_kind``, the attribute
+    ``resilience.classify`` trusts verbatim. Either way the
+    coordinator's retry loop sees the kind an in-process attempt would
+    have produced."""
+    import builtins
+
+    etype = getattr(builtins, type_name, None)
+    if not (isinstance(etype, type) and issubclass(etype, Exception)):
+        etype = getattr(resilience, type_name, None)
+    if isinstance(etype, type) and issubclass(etype, Exception):
+        try:
+            err = etype(msg)
+            if resilience.classify(err) == kind:
+                return err
+        except Exception:  # pragma: no cover - exotic ctor signature
+            pass
+    err = RuntimeError(f"{type_name}: {msg} (from cluster worker)")
+    err.failure_kind = kind  # type: ignore[attr-defined]
+    return err
+
+
+class _Task:
+    """One in-flight partition dispatch: the wire payload plus
+    everything needed to re-dispatch it after a worker death."""
+
+    __slots__ = ("task_id", "index", "token", "payload", "rows", "event",
+                 "result", "error", "worker", "redispatches")
+
+    def __init__(self, index: int, token: str, payload: bytes,
+                 rows: int) -> None:
+        self.task_id = 0
+        self.index = index
+        self.token = token
+        self.payload = payload
+        self.rows = rows
+        self.event = threading.Event()
+        self.result: Any = None
+        self.error: Optional[BaseException] = None
+        self.worker: Optional[int] = None
+        self.redispatches = 0
+
+
+class _Worker:
+    """One worker process plus its PRIVATE task queue, its PRIVATE
+    result pipe, the op-chain tokens already shipped to it, and its
+    in-flight task ids / outstanding rows (the load signal)."""
+
+    __slots__ = ("wid", "proc", "queue", "conn", "assigned", "tokens",
+                 "outstanding_rows", "finished", "lost")
+
+    def __init__(self, wid: int, proc: Any, queue: Any, conn: Any) -> None:
+        self.wid = wid
+        self.proc = proc
+        self.queue = queue
+        self.conn = conn  # parent's read end; None once EOF-drained
+        self.assigned: Set[int] = set()
+        self.tokens: Set[str] = set()
+        self.outstanding_rows = 0
+        self.finished = False  # final snapshot received
+        self.lost = False      # died without a final snapshot
+
+
+class ClusterRouter:
+    """N spawn-context cluster workers behind a load-aware dispatch.
+
+    ::
+
+        router = ClusterRouter(workers=2)
+        try:
+            out = router.run_partition(i, batch, ops)
+        finally:
+            router.close()   # joins workers, merges their snapshots
+
+    ``run_partition`` is thread-safe (concurrent partition tasks share
+    the router and the ``cluster_inflight_partitions`` backpressure
+    bound) and is a drop-in for ``dataframe._run_partition`` — callers
+    normally never construct one; :func:`maybe_router` manages the
+    process-wide instance from ``EngineConfig.cluster_workers``. The
+    coordinator's run id (from the active telemetry scope, if any) is
+    pinned into every worker's ``Telemetry(run_id=...)`` at spawn.
+    """
+
+    def __init__(self, workers: int, inflight: Optional[int] = None,
+                 run_id: Optional[str] = None) -> None:
+        if workers < 1:
+            raise ValueError(
+                f"cluster router needs >= 1 worker, got {workers}")
+        self.workers = int(workers)
+        self.inflight = int(inflight) if inflight else 2 * self.workers
+        if self.inflight < 1:
+            raise ValueError(
+                f"cluster_inflight_partitions must be >= 1, got "
+                f"{inflight!r}")
+        tel = telemetry.active()
+        self.run_id = run_id or (
+            tel.run_id if tel is not None
+            else f"cluster-{os.getpid():x}-{next(_run_ids):04x}")
+        # workers must land on the coordinator's RESOLVED backend and
+        # config — a spawned interpreter re-derives both from scratch
+        # otherwise (env vars, sitecustomize), and "cluster on" must
+        # not change what runs
+        import jax
+
+        from sparkdl_tpu.engine.dataframe import EngineConfig
+
+        config = EngineConfig.snapshot()
+        # a worker must never recurse into its own cluster, journal
+        # coordinator-owned state, or nest a decode pool per worker
+        config.update(cluster_workers=0, cluster_inflight_partitions=None,
+                      decode_workers=0, decode_pool_inflight=None,
+                      durable_dir=None)
+        import cloudpickle
+
+        self._boot_blob = cloudpickle.dumps(
+            {"config": config, "platform": jax.default_backend()})
+        self._lock = threading.Lock()
+        self._pending: Dict[int, _Task] = {}
+        self._ids = itertools.count(1)
+        self._ops_blobs: Dict[str, bytes] = {}
+        self._token_cache: Dict[Tuple[int, str], str] = {}
+        self._finals: List[Dict[str, Any]] = []
+        self._sem = threading.BoundedSemaphore(self.inflight)
+        self._closed = False
+        # bench accounting: wall time inside dispatch vs worker-measured
+        # op-chain time (their gap is the router's overhead)
+        self.dispatch_s_total = 0.0
+        self.exec_s_total = 0.0
+        self.worker_snapshots: List[Dict[str, Any]] = []
+        self.cluster_report: Optional[Dict[str, Any]] = None
+        self.run_report: Optional[Dict[str, Any]] = None
+        # parent-internal wakeup pipe: nudges the collector out of its
+        # connection.wait when the router closes
+        self._wake_r, self._wake_w = _MP_CTX.Pipe(duplex=False)
+        # incremental append (not a comprehension): a spawn failing at
+        # worker k must leave workers 0..k-1 poisonable, not leaked
+        self._workers: List[_Worker] = []
+        try:
+            for i in range(self.workers):
+                self._workers.append(self._spawn(i))
+        except BaseException:
+            for worker in self._workers:
+                worker.queue.put(None)
+                worker.proc.join(timeout=_JOIN_TIMEOUT_S)
+                worker.queue.cancel_join_thread()
+                worker.queue.close()
+                worker.conn.close()
+            self._wake_r.close()
+            self._wake_w.close()
+            self._closed = True
+            raise
+        self._collector = threading.Thread(
+            target=self._collect, name="sparkdl-cluster-collector",
+            daemon=True)
+        self._collector.start()
+
+    def _spawn(self, index: int) -> _Worker:
+        queue = _MP_CTX.Queue()
+        recv_conn, send_conn = _MP_CTX.Pipe(duplex=False)
+        proc = _MP_CTX.Process(
+            target=_worker_mod._worker_main,
+            args=(index, queue, send_conn, os.getpid(), self.run_id,
+                  self._boot_blob),
+            name=f"sparkdl-cluster-{index}", daemon=True)
+        proc.start()
+        # drop the parent's copy of the write end: the worker owns the
+        # only writer, so worker death shows up as EOF on recv_conn
+        send_conn.close()
+        health.record(health.CLUSTER_WORKER_STARTED, worker=proc.name)
+        return _Worker(index, proc, queue, recv_conn)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    # -- the public partition entry point ------------------------------------
+
+    def run_partition(self, index: int, batch: Any,
+                      ops: Sequence[Any],
+                      cancelled: Optional[threading.Event] = None) -> Any:
+        """Drop-in for ``dataframe._run_partition``: the same supervisor
+        retry loop, with the op chain executed on a cluster worker
+        instead of this thread. Row/byte counting mirrors the inline
+        path exactly (supervised attempts are counted once per winning
+        attempt by the supervisor's resolve)."""
+        from sparkdl_tpu.engine import dataframe as _df
+        from sparkdl_tpu.engine import supervisor as _sup
+
+        cfg = _df.EngineConfig
+        chain = [self._remote_op(index, ops, cancelled)]
+        out = _sup.run_partition_task(
+            index, batch, chain, policy=_df._task_policy(),
+            deadline_s=cfg.task_timeout_s,
+            legacy_injector=cfg.fault_injector,
+            max_fatal_attempts=(cfg.quarantine_max_fatal
+                                if cfg.quarantine else 1),
+            cancelled=cancelled)
+        if cancelled is None and telemetry.active() is not None:
+            telemetry.count(telemetry.M_ENGINE_ROWS_OUT, out.num_rows)
+            telemetry.count(telemetry.M_ENGINE_BYTES_OUT, out.nbytes)
+        return out
+
+    def _remote_op(self, index: int, ops: Sequence[Any],
+                   cancelled: Optional[threading.Event]):
+        """The one-op chain handed to the supervisor: each invocation
+        (first attempt, classified retry, hedge, quarantine confirm) is
+        a FRESH dispatch — worker selection happens per attempt, which
+        is exactly what gives retries-after-death and hedges their
+        anti-affinity."""
+        token = self._ops_payload(ops)
+
+        def dispatch(batch: Any) -> Any:
+            t0 = time.monotonic()
+            with telemetry.span(telemetry.SPAN_CLUSTER_DISPATCH,
+                                partition=index):
+                task = self._submit(index, batch, token)
+                out = self._await(task, cancelled)
+            dt = time.monotonic() - t0
+            with self._lock:
+                self.dispatch_s_total += dt
+            if telemetry.active() is not None:
+                telemetry.observe(telemetry.M_CLUSTER_DISPATCH_S, dt)
+            return out
+
+        return dispatch
+
+    def _ops_payload(self, ops: Sequence[Any]) -> str:
+        """Ship-once op-chain registration. The token is
+        ``durability.ops_token`` (the same canonicalization ``job_id``
+        hashes — cluster transport and durable journals agree on chain
+        identity) suffixed with the pickled payload's digest, so two
+        chains the repr-canonicalization cannot distinguish still get
+        distinct cache slots."""
+        base = durability.ops_token(ops)
+        key = (id(ops), base)
+        with self._lock:
+            token = self._token_cache.get(key)
+            if token is not None:
+                return token
+        import cloudpickle
+
+        blob = cloudpickle.dumps(list(ops))
+        token = f"{base}.{hashlib.sha256(blob).hexdigest()[:12]}"
+        with self._lock:
+            self._ops_blobs.setdefault(token, blob)
+            if len(self._token_cache) > 256:  # id()s recycle across jobs
+                self._token_cache.clear()
+            self._token_cache[key] = token
+        return token
+
+    # -- submission / waiting ------------------------------------------------
+
+    def _submit(self, index: int, batch: Any, token: str) -> _Task:
+        payload = _worker_mod._ipc_bytes(batch)
+        # bounded in-flight: backpressure here, with close detection so
+        # a closed router cannot wedge a submitter forever
+        while not self._sem.acquire(timeout=_WAIT_POLL_S):
+            if self._closed:
+                raise resilience.ClusterWorkerLost(
+                    "cluster router closed while a dispatch was waiting "
+                    "for an in-flight slot")
+        task = _Task(index, token, payload, batch.num_rows)
+        with self._lock:
+            if self._closed:
+                self._sem.release()
+                raise resilience.ClusterWorkerLost(
+                    "cluster router closed before the partition was "
+                    "dispatched")
+            task.task_id = next(self._ids)
+            # hedge anti-affinity: a concurrent in-flight attempt of
+            # the SAME partition must land on a different worker
+            exclude = {t.worker for t in self._pending.values()
+                       if t.index == index and t.worker is not None}
+            self._pending[task.task_id] = task
+            try:
+                self._dispatch_locked(task, exclude)
+            except BaseException:
+                del self._pending[task.task_id]
+                self._sem.release()
+                raise
+            total = self._outstanding_locked()
+        self._gauge(total)
+        return task
+
+    def _dispatch_locked(self, task: _Task,
+                         exclude: Set[Any] = frozenset()) -> None:
+        """Hand a task to the least-loaded live worker (caller holds
+        the lock). Load = outstanding rows (ties: in-flight task
+        count). The armed ``cluster_worker_kill`` marker rides ON the
+        task message, so the chosen worker dies holding exactly this
+        partition — the precise re-dispatch path is what the injection
+        exercises. Anti-affinity is best-effort: with every live worker
+        excluded, landing somewhere beats failing the attempt."""
+        live = [w for w in self._workers if not w.lost and not w.finished]
+        candidates = [w for w in live if w.wid not in exclude] or live
+        if not candidates:
+            raise resilience.ClusterWorkerLost(
+                f"no live cluster workers to run partition {task.index}")
+        worker = min(candidates,
+                     key=lambda w: (w.outstanding_rows, len(w.assigned)))
+        if task.token not in worker.tokens:
+            worker.queue.put(("ops", task.token,
+                              self._ops_blobs[task.token]))
+            worker.tokens.add(task.token)
+        crash = resilience.should_fire("cluster_worker_kill",
+                                       partition=task.index)
+        worker.queue.put(("task", task.task_id, task.index, task.token,
+                          task.payload, crash))
+        worker.assigned.add(task.task_id)
+        worker.outstanding_rows += task.rows
+        task.worker = worker.wid
+
+    def _await(self, task: _Task,
+               cancelled: Optional[threading.Event]) -> Any:
+        while not task.event.wait(_WAIT_POLL_S):
+            if cancelled is not None and cancelled.is_set():
+                # supervisor watchdog abandoned this attempt (deadline,
+                # or a hedge already won): stop waiting; the worker's
+                # late result resolves to an already-popped task and is
+                # dropped by the collector
+                self._abandon(task)
+                raise resilience.ClusterWorkerLost(
+                    f"partition {task.index} dispatch abandoned "
+                    "(supervisor cancelled the attempt)")
+        if task.error is not None:
+            raise task.error
+        return task.result
+
+    def _abandon(self, task: _Task) -> None:
+        with self._lock:
+            if self._pending.pop(task.task_id, None) is None:
+                return  # resolved concurrently; collector released
+            self._discount_locked(task)
+            total = self._outstanding_locked()
+        self._sem.release()
+        self._gauge(total)
+
+    def _discount_locked(self, task: _Task) -> None:
+        for worker in self._workers:
+            if task.task_id in worker.assigned:
+                worker.assigned.discard(task.task_id)
+                worker.outstanding_rows = max(
+                    0, worker.outstanding_rows - task.rows)
+
+    def _outstanding_locked(self) -> int:
+        return sum(w.outstanding_rows for w in self._workers)
+
+    def _gauge(self, total: int) -> None:
+        if telemetry.active() is not None:
+            telemetry.gauge_set(telemetry.M_CLUSTER_OUTSTANDING_ROWS,
+                                total)
+
+    # -- the collector thread ------------------------------------------------
+
+    def _collect(self) -> None:
+        """Multiplex every worker's private result pipe. EOF on a pipe
+        is the death (or clean-exit) signal; a dead worker's in-flight
+        partitions are re-dispatched to survivors right here, so
+        detection latency is one pipe wakeup, not a poll interval.
+        Exits once the router is closed and every conn has EOF'd —
+        which guarantees every final snapshot has been adopted."""
+        from multiprocessing import connection as _mpc
+
+        while True:
+            with self._lock:
+                conn_map = {w.conn: w for w in self._workers
+                            if w.conn is not None}
+                done = self._closed and not conn_map
+            if done:
+                return
+            for ready in _mpc.wait(list(conn_map) + [self._wake_r]):
+                if ready is self._wake_r:
+                    try:
+                        self._wake_r.recv_bytes()
+                    except (EOFError, OSError):  # pragma: no cover
+                        pass
+                    continue
+                worker = conn_map[ready]
+                try:
+                    msg = ready.recv()
+                except (EOFError, OSError):
+                    ready.close()
+                    self._on_worker_eof(worker)
+                    continue
+                self._on_message(worker, msg)
+
+    def _on_message(self, worker: _Worker, msg: Tuple) -> None:
+        kind = msg[0]
+        if kind == "final":
+            with self._lock:
+                worker.finished = True
+                self._finals.append(msg[2])
+            return
+        task_id = msg[1]
+        with self._lock:
+            task = self._pending.pop(task_id, None)
+            if task is not None:
+                self._discount_locked(task)
+            total = self._outstanding_locked()
+        if task is None:
+            return  # re-dispatch duplicate or abandoned attempt
+        if kind == "ok":
+            _, _, payload, meta = msg
+            task.result = _worker_mod._batch_from_ipc(payload)
+            with self._lock:
+                self.exec_s_total += float(meta.get("exec_s", 0.0))
+        else:
+            _, _, type_name, message, err_kind = msg
+            task.error = _rebuild_error(type_name, message, err_kind)
+        task.event.set()
+        self._sem.release()
+        self._gauge(total)
+
+    def _on_worker_eof(self, worker: _Worker) -> None:
+        """A worker's pipe hit EOF. Clean exit (final already adopted,
+        or the router is closing) just retires the conn; a DEATH marks
+        the worker lost, abandons its queue, and re-dispatches exactly
+        its in-flight task ids to survivors — one ``cluster_worker_lost``
+        event per death, one ``cluster_redispatch`` per moved
+        partition. No survivors: the partitions fail with a RETRYABLE
+        ``ClusterWorkerLost`` and the supervisor's retry loop decides."""
+        redispatched: List[_Task] = []
+        failed: List[_Task] = []
+        lost = False
+        with self._lock:
+            worker.conn = None
+            if not worker.finished and not self._closed:
+                lost = True
+                worker.lost = True
+                # abandon the dead worker's queue WITHOUT joining its
+                # feeder thread (it may be blocked writing to a pipe
+                # nobody will ever read — the decode-pool lesson)
+                worker.queue.cancel_join_thread()
+                worker.queue.close()
+                held = sorted(worker.assigned)
+                worker.assigned.clear()
+                worker.outstanding_rows = 0
+                for task_id in held:
+                    task = self._pending.get(task_id)
+                    if task is None:
+                        continue  # delivered just before dying
+                    task.redispatches += 1
+                    try:
+                        self._dispatch_locked(task, exclude={worker.wid})
+                        redispatched.append(task)
+                    except resilience.ClusterWorkerLost as e:
+                        del self._pending[task_id]
+                        task.error = e
+                        failed.append(task)
+        if lost:
+            logger.warning(
+                "cluster worker %s died; re-dispatched %d in-flight "
+                "partition(s) to survivors (%d unplaceable)",
+                worker.proc.name, len(redispatched), len(failed))
+            health.record(health.CLUSTER_WORKER_LOST,
+                          worker=worker.proc.name)
+            for task in redispatched:
+                health.record(health.CLUSTER_REDISPATCH,
+                              partition=task.index,
+                              worker=worker.proc.name)
+                if telemetry.active() is not None:
+                    telemetry.count(telemetry.M_CLUSTER_REDISPATCH)
+        for task in failed:
+            task.event.set()
+            self._sem.release()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self) -> None:
+        """Poison, join, and reap every worker; drain every pipe to EOF
+        (adopting the final snapshots); merge the snapshots into
+        :attr:`cluster_report` / :attr:`run_report`. Idempotent; safe
+        mid-stream (waiters fail with a RETRYABLE ClusterWorkerLost
+        rather than hanging)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            abandoned = list(self._pending.values())
+            self._pending.clear()
+            for worker in self._workers:
+                worker.assigned.clear()
+                worker.outstanding_rows = 0
+            workers = list(self._workers)
+        for worker in workers:
+            try:
+                worker.queue.put(None)  # poison pill per private queue
+            except ValueError:  # queue closed by a concurrent EOF reap
+                pass
+        for worker in workers:
+            worker.proc.join(timeout=_JOIN_TIMEOUT_S)
+            if worker.proc.is_alive():  # pragma: no cover - wedged worker
+                worker.proc.terminate()
+                worker.proc.join(timeout=_JOIN_TIMEOUT_S)
+            # a dead worker never consumed its pill; don't let the
+            # queue's feeder thread block interpreter exit on it
+            worker.queue.cancel_join_thread()
+            worker.queue.close()
+        # the joins closed every write end: the collector drains each
+        # conn to EOF — adopting every final snapshot — then sees
+        # closed + no live conns and exits; the wake byte covers it
+        # being parked on an empty list
+        self._wake_w.send_bytes(b"c")
+        self._collector.join()
+        for task in abandoned:
+            task.error = resilience.ClusterWorkerLost(
+                "cluster router closed mid-stream")
+            task.event.set()
+            self._sem.release()
+        self._wake_w.close()
+        self._wake_r.close()
+        with self._lock:
+            finals = list(self._finals)
+        self.worker_snapshots = finals
+        self.cluster_report = aggregate.merge_snapshots(finals)
+        tel = telemetry.active()
+        self.run_report = (aggregate.merged_run_report(tel, finals)
+                           if tel is not None else None)
+
+    def __enter__(self) -> "ClusterRouter":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+    def __del__(self) -> None:  # safety net only; callers use close()/with
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 - interpreter teardown
+            pass
+
+
+# ---------------------------------------------------------------------------
+# The process-wide router (EngineConfig.cluster_workers is the ONE knob)
+# ---------------------------------------------------------------------------
+
+_router_lock = threading.Lock()
+_router: Optional[ClusterRouter] = None
+_router_key: Optional[Tuple[int, Optional[int]]] = None
+_last_router: Optional[ClusterRouter] = None
+
+
+def maybe_router() -> Optional[ClusterRouter]:
+    """The process-wide router per ``EngineConfig.cluster_workers``, or
+    ``None`` when the cluster plane is disabled (``cluster_workers=0``,
+    the bit-identical in-process default) or when called from inside a
+    cluster worker. Reconfiguring the knobs closes the old router (and
+    merges its reports) before spawning the new one."""
+    if _worker_mod._IN_WORKER:
+        return None
+    from sparkdl_tpu.engine.dataframe import EngineConfig
+
+    EngineConfig.validate()
+    workers = EngineConfig.cluster_workers
+    if not workers:
+        return None
+    key = (workers, EngineConfig.cluster_inflight_partitions)
+    global _router, _router_key, _last_router
+    with _router_lock:
+        stale = _router
+        if stale is not None and _router_key == key and not stale.closed:
+            return stale
+        _router = None
+    if stale is not None:
+        stale.close()  # outside the lock: close() joins processes
+        _last_router = stale
+    with _router_lock:
+        if _router is None or _router_key != key or _router.closed:
+            _router = ClusterRouter(
+                workers, inflight=EngineConfig.cluster_inflight_partitions)
+            _router_key = key
+        return _router
+
+
+def shutdown() -> None:
+    """Close the process-wide router (tests, bench legs, atexit) —
+    this is the moment workers ship their snapshots and the merged
+    reports land (readable via :func:`last_cluster_report`)."""
+    global _router, _last_router
+    with _router_lock:
+        router, _router = _router, None
+    if router is not None:
+        router.close()
+        _last_router = router
+
+
+def last_cluster_report() -> Optional[Dict[str, Any]]:
+    """The most recent merged per-worker snapshot section (survives
+    :func:`shutdown` — reports are produced BY closing)."""
+    router = _router if _router is not None else _last_router
+    return router.cluster_report if router is not None else None
+
+
+def last_run_report() -> Optional[Dict[str, Any]]:
+    """The most recent merged ``RunReport`` (coordinator report + the
+    ``cluster`` section), if a telemetry scope was active at close."""
+    router = _router if _router is not None else _last_router
+    return router.run_report if router is not None else None
+
+
+atexit.register(shutdown)

@@ -1,0 +1,177 @@
+"""Cluster worker process: one full per-process inference stack.
+
+Each worker is a **spawn-context** process (never fork — the
+coordinator owns a live JAX/PJRT runtime; a forked child inheriting
+device handles is undefined behavior, the same rule
+``core/decode_pool.py`` established) that hosts everything a
+single-process run would: its own device runtime, its own
+``DeviceExecutor`` + compiled-fn cache (reached through the op chain
+exactly as inline execution reaches them), and its own
+``Telemetry(run_id=...)`` scope pinned to the COORDINATOR's run id so
+every worker's spans and metrics carry the same run identity the
+merged report (``cluster/aggregate.py``) is keyed on.
+
+Transport mirrors the decode pool: a PRIVATE task queue in and a
+PRIVATE result pipe back per worker — one writer per pipe, so a worker
+killed mid-delivery corrupts only its own channel and the router's
+collector sees the death as EOF. Op chains ship once per distinct
+chain as cloudpickle blobs keyed by the token
+``cluster/router.py`` derives from ``core/durability.py``'s op-chain
+canonicalization (``durability.ops_token``), then partitions reference
+the token — model weights cross the pipe once, not per partition.
+
+Boot order matters: the jax platform is pinned from the coordinator's
+resolved backend BEFORE any backend initialization (a spawned
+interpreter re-runs ``sitecustomize``/env resolution from scratch —
+the coordinator's choice must win), then the coordinator's
+``EngineConfig`` snapshot is restored with the cluster/durability/
+decode-pool knobs forced off (a worker must never recurse into
+another cluster, journal coordinator-owned state, or nest decode
+pools under the coordinator's pool).
+
+Protocol (parent -> worker queue):
+  ``("ops", token, blob)``                      register an op chain
+  ``("task", task_id, index, token, ipc, crash)``  run one partition
+  ``None``                                      poison pill
+(worker -> parent pipe):
+  ``("ok", task_id, ipc, meta)`` / ``("err", task_id, type, msg, kind)``
+  ``("final", worker_id, snapshot)``            last message before EOF
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+from queue import Empty
+from typing import Any, Dict
+
+# Idle-worker orphan watch (same rationale as the decode pool): a
+# kill -9'd coordinator can never deliver the poison pill, so
+# reparenting is the worker's only death signal.
+_ORPHAN_POLL_S = 5.0
+
+# True inside a spawned cluster worker (set by _worker_main): a worker
+# must never route its own partitions back into a router —
+# ``router.maybe_router`` checks this, and the restored EngineConfig
+# forces cluster_workers=0 anyway (belt and braces).
+_IN_WORKER = False
+
+
+def _ipc_bytes(batch: Any) -> bytes:
+    """One-batch Arrow IPC stream — the partition wire format (the same
+    encoding ``core/durability.py`` spills, so cluster transport and
+    durable spills agree byte-for-byte on what a partition *is*)."""
+    import io
+
+    import pyarrow as pa
+
+    sink = io.BytesIO()
+    with pa.ipc.new_stream(sink, batch.schema) as writer:
+        writer.write_batch(batch)
+    return sink.getvalue()
+
+
+def _batch_from_ipc(payload: bytes) -> Any:
+    import io
+
+    import pyarrow as pa
+
+    with pa.ipc.open_stream(io.BytesIO(payload)) as reader:
+        batches = [b for b in reader]
+    if len(batches) != 1:
+        raise IOError(
+            f"cluster task payload holds {len(batches)} batches, "
+            "expected 1")
+    return batches[0]
+
+
+def _worker_main(worker_id: int, tasks: Any, conn: Any, owner_pid: int,
+                 run_id: str, boot_blob: bytes) -> None:
+    """Worker process loop: execute partition op chains until the
+    ``None`` poison pill, then ship the end-of-run snapshot and EOF.
+
+    Classified retry, hedging, quarantine, deadlines, and fault
+    injection all stay COORDINATOR-side (the router routes through
+    ``engine/supervisor.py``); this loop only executes one attempt's op
+    chain and reports the outcome — an exception ships back typed with
+    its ``resilience.classify`` kind so the coordinator's retry loop
+    sees exactly what an in-process attempt would have raised. Only the
+    armed ``cluster_worker_kill`` marker (evaluated coordinator-side,
+    riding on the task message) kills the process — SIGKILL, no
+    cleanup, exactly what the chaos leg needs.
+    """
+    global _IN_WORKER
+    _IN_WORKER = True
+    import cloudpickle
+
+    boot = cloudpickle.loads(boot_blob)
+    # pin the platform BEFORE anything can initialize the backend: the
+    # spawned interpreter re-resolves platform selection from scratch
+    # and must land where the coordinator landed
+    import jax
+
+    jax.config.update("jax_platforms", boot["platform"])
+    from sparkdl_tpu.cluster import aggregate
+    from sparkdl_tpu.core import health, profiling, resilience, telemetry
+    from sparkdl_tpu.engine.dataframe import EngineConfig
+
+    EngineConfig.restore(boot["config"])
+    name = f"sparkdl-cluster-{worker_id}"
+    ops_cache: Dict[str, Any] = {}
+    tasks_done = 0
+    rows_out = 0
+    exec_s_total = 0.0
+    snapshot: Dict[str, Any] = {}
+    # monitor OUTSIDE the telemetry scope (the documented nesting that
+    # folds health into reports); out_dir="" suppresses file export —
+    # the snapshot ships over the pipe instead
+    monitor = health.HealthMonitor(name)
+    with monitor, telemetry.Telemetry(
+            name=name, out_dir="", run_id=run_id) as tel:
+        while True:
+            try:
+                msg = tasks.get(timeout=_ORPHAN_POLL_S)
+            except Empty:
+                if os.getppid() != owner_pid:  # orphaned: owner died hard
+                    conn.close()
+                    return
+                continue
+            if msg is None:
+                break
+            if msg[0] == "ops":
+                _, token, blob = msg
+                ops_cache[token] = cloudpickle.loads(blob)
+                continue
+            _, task_id, index, token, payload, crash = msg
+            if crash:
+                # injected worker death (chaos leg): die as hard as a
+                # machine loss — no cleanup, no final snapshot
+                os.kill(os.getpid(), signal.SIGKILL)
+            t0 = time.perf_counter()
+            try:
+                ops = ops_cache[token]
+                out = _batch_from_ipc(payload)
+                with telemetry.span(telemetry.SPAN_TASK, partition=index,
+                                    cluster_worker=worker_id):
+                    for op in ops:
+                        out = op(out)
+                result = _ipc_bytes(out)
+            # sparkdl: allow(broad-retry): not a retry — the error ships typed (with its classify kind) to the coordinator, whose supervisor owns the retry decision
+            except Exception as e:  # noqa: BLE001 - re-raised parent-side
+                conn.send(("err", task_id, type(e).__name__, str(e),
+                           resilience.classify(e)))
+                continue
+            dt = time.perf_counter() - t0
+            tasks_done += 1
+            rows_out += out.num_rows
+            exec_s_total += dt
+            conn.send(("ok", task_id, result,
+                       {"exec_s": dt, "rows": out.num_rows}))
+        # end-of-run snapshot, built while the scopes are still active
+        snapshot = aggregate.build_snapshot(
+            name, os.getpid(), tel, monitor, tasks=tasks_done,
+            rows=rows_out, exec_s=exec_s_total,
+            phases=profiling.phase_stats())
+    conn.send(("final", worker_id, snapshot))
+    conn.close()

@@ -153,6 +153,19 @@ def _op_token(op: Any) -> str:
     return "|".join(parts)
 
 
+def ops_token(ops: Sequence[Any]) -> str:
+    """Stable fingerprint of an op CHAIN alone (no input data): the
+    ``_op_token`` canonicalization :func:`job_id` already applies, hashed.
+    The cluster router keys shipped op-chain payloads on this, so a
+    worker that has already received a chain (a streamed epoch, a retry)
+    is not re-sent the pickled closures."""
+    h = hashlib.sha256()
+    for op in ops:
+        h.update(_op_token(op).encode())
+        h.update(b"\x00")
+    return h.hexdigest()[:20]
+
+
 def job_id(partitions: Sequence[pa.RecordBatch],
            schema: Optional[pa.Schema],
            ops: Sequence[Any]) -> str:

@@ -88,6 +88,13 @@ SERVING_EVICTED = "serving_evicted"      # serving: residency dropped a
 SERVING_COLD_START = "serving_cold_start"  # serving: loader ran on a
                                          # residency miss (first load OR
                                          # reload after eviction)
+CLUSTER_WORKER_STARTED = "cluster_worker_started"  # cluster: a worker
+                                         # process was spawned
+CLUSTER_WORKER_LOST = "cluster_worker_lost"  # cluster: a worker died
+                                         # (EOF on its result pipe)
+CLUSTER_REDISPATCH = "cluster_redispatch"  # cluster: a dead worker's
+                                         # in-flight partition re-sent
+                                         # to a survivor
 
 
 class HealthMonitor:

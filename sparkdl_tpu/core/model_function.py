@@ -147,6 +147,27 @@ class ModelFunction:
         self._resize_cache: Dict[Tuple[int, int], "ModelFunction"] = {}
         self._precision_cache: Dict[str, "ModelFunction"] = {}
 
+    # -- cluster transport ----------------------------------------------------
+
+    def __getstate__(self) -> Dict[str, Any]:
+        # Op chains cross process boundaries via cloudpickle when the
+        # cluster plane is armed (cluster/worker.py). What defines the
+        # model — apply_fn, variables, spec — ships; the jit cache
+        # (process-local compiled handles), its lock, and the derived-
+        # model caches are per-process state the receiving worker must
+        # rebuild on first use, so they are stripped rather than pickled.
+        state = self.__dict__.copy()
+        state["_jit_cache"] = {}
+        state["_jit_lock"] = None
+        state["_flat_cache"] = None
+        state["_resize_cache"] = {}
+        state["_precision_cache"] = {}
+        return state
+
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        self.__dict__.update(state)
+        self._jit_lock = threading.Lock()
+
     # -- construction matrix (TFInputGraph parity) ---------------------------
 
     @classmethod

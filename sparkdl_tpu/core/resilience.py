@@ -120,6 +120,17 @@ class DecodeWorkerLost(RuntimeError):
     single taxonomy source without an import cycle."""
 
 
+class ClusterWorkerLost(RuntimeError):
+    """A cluster worker process died (EOF on its result pipe) while a
+    partition dispatch was in flight and no survivor could absorb the
+    re-dispatch (``sparkdl_tpu/cluster/router.py``). RETRYABLE by
+    definition: worker loss is transient infrastructure failure — the
+    engine's classified task retry re-dispatches the partition, and the
+    router re-routes around the dead worker. Defined here (not in the
+    cluster package) so :func:`classify` stays the single taxonomy
+    source without an import cycle."""
+
+
 class StaleCheckpointWriter(RuntimeError):
     """A checkpoint save was refused by the fencing token: this process
     belongs to a superseded gang incarnation and a newer writer has
@@ -181,7 +192,8 @@ def classify(err: BaseException) -> str:
     if isinstance(err, DeviceOOM):
         return OOM
     if isinstance(err, (Preemption, TransferStall, ExecutorOverloaded,
-                        ExecutorCircuitOpen, DecodeWorkerLost)):
+                        ExecutorCircuitOpen, DecodeWorkerLost,
+                        ClusterWorkerLost)):
         return RETRYABLE
     if isinstance(err, DeadlineExceeded):
         return FATAL  # the deadline IS the retry budget; never retry past it
@@ -350,6 +362,13 @@ INJECTION_POINTS: Dict[str, Tuple[str, Optional[Callable[[], BaseException]]]] =
         "(core/durability.py); ctx carries partition — exercises "
         "kill -9 resume: a restarted job must load the committed "
         "partitions from spill and recompute only the rest", None),
+    "cluster_worker_kill": (
+        "behavioral: the cluster router marks the next dispatched "
+        "partition so its worker process SIGKILLs itself on receipt "
+        "(sparkdl_tpu/cluster/); ctx carries partition — exercises "
+        "EOF death detection, precise re-dispatch of the dead worker's "
+        "in-flight partitions to survivors, and the merged-report "
+        "accounting for a lost worker", None),
 }
 
 

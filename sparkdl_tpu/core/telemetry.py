@@ -107,13 +107,16 @@ SPAN_DECODE_POOL = "sparkdl.decode_pool"      # one pooled decode fan-out
 SPAN_MODEL_LOAD = "sparkdl.model_load"        # serving cold start: loader
                                               # run on a residency miss
                                               # (serving/residency.py)
+SPAN_CLUSTER_DISPATCH = "sparkdl.cluster_dispatch"  # one partition's
+                                              # round trip to a cluster
+                                              # worker (cluster/router.py)
 
 CANONICAL_SPAN_NAMES = frozenset({
     SPAN_RUN, SPAN_RUNNER_ATTEMPT, SPAN_FIT, SPAN_EPOCH,
     SPAN_CHECKPOINT_SAVE, SPAN_ESTIMATOR_FIT, SPAN_COLLECT,
     SPAN_MATERIALIZE, SPAN_TASK, SPAN_TASK_ATTEMPT,
     SPAN_COMPILE, SPAN_COALESCED_LAUNCH, SPAN_DECODE_POOL,
-    SPAN_MODEL_LOAD,
+    SPAN_MODEL_LOAD, SPAN_CLUSTER_DISPATCH,
     # phase names (core/profiling.py constants + literal call sites)
     "sparkdl.decode", "sparkdl.stage", "sparkdl.stage_batch",
     "sparkdl.host_stage", "sparkdl.host_resize", "sparkdl.host_wait",
@@ -172,6 +175,17 @@ M_SERVING_SHADOW_DIVERGENCE = "sparkdl.serving.shadow_divergence"
                                                        # histogram (max
                                                        # |active-shadow|)
 M_SERVING_EVICTIONS = "sparkdl.serving.evictions"      # counter
+# Cluster inference plane (sparkdl_tpu/cluster/, docs/DISTRIBUTED.md
+# "Cluster inference"): the router's load/latency view. Worker-loss and
+# re-dispatch COUNTS also arrive as sparkdl.health.* mirrors; the
+# redispatch counter below is the router's own canonical series.
+M_CLUSTER_OUTSTANDING_ROWS = "sparkdl.cluster.outstanding_rows"  # gauge
+                                                       # (rows in flight
+                                                       # across workers)
+M_CLUSTER_DISPATCH_S = "sparkdl.cluster.dispatch_s"    # histogram (per
+                                                       # partition round
+                                                       # trip)
+M_CLUSTER_REDISPATCH = "sparkdl.cluster.redispatch"    # counter
 HEALTH_METRIC_PREFIX = "sparkdl.health."
 
 # Instrument kind per canonical metric — machine-readable so core/slo.py
@@ -207,6 +221,9 @@ CANONICAL_METRIC_KINDS: Dict[str, str] = {
     M_SERVING_QUEUE_DEPTH: "gauge",
     M_SERVING_SHADOW_DIVERGENCE: "histogram",
     M_SERVING_EVICTIONS: "counter",
+    M_CLUSTER_OUTSTANDING_ROWS: "gauge",
+    M_CLUSTER_DISPATCH_S: "histogram",
+    M_CLUSTER_REDISPATCH: "counter",
 }
 
 CANONICAL_METRIC_NAMES = frozenset(CANONICAL_METRIC_KINDS)

@@ -153,6 +153,20 @@ class EngineConfig:
     # committed partitions load from verified spill, only uncommitted
     # ones recompute, and rows re-emit in original order.
     durable_dir: Optional[str] = None
+    # -- cluster inference plane (sparkdl_tpu/cluster/, docs/DISTRIBUTED.md
+    # "Cluster inference") -----------------------------------------------------
+    # Spawn-context worker PROCESSES, each hosting a full per-process
+    # inference stack (own device runtime, DeviceExecutor + compiled-fn
+    # cache, telemetry pinned to the coordinator's run id); supervised
+    # materialize/stream partitions route to the least-loaded worker,
+    # with retry/hedging/quarantine/deadlines preserved coordinator-side.
+    # 0 (default) keeps today's in-process path byte-identical — the
+    # cluster package is never even imported.
+    cluster_workers: int = 0
+    # Max in-flight partition dispatches router-wide (backpressure bound
+    # on coordinator memory for shipped-but-unconsumed partitions);
+    # None = 2 * cluster_workers.
+    cluster_inflight_partitions: Optional[int] = None
     max_workers: int = max(2, (os.cpu_count() or 4) // 2)
     # DEPRECATED test hook (SURVEY.md §5.3 fault injection):
     # callable(partition_index, attempt) that may raise to simulate a task
@@ -206,7 +220,9 @@ class EngineConfig:
                  cls.executor_breaker_window_s,
                  cls.executor_breaker_cooldown_s,
                  cls.executor_idle_retire_s, cls.decode_workers,
-                 cls.decode_pool_inflight, cls.durable_dir, cls.max_workers)
+                 cls.decode_pool_inflight, cls.cluster_workers,
+                 cls.cluster_inflight_partitions, cls.durable_dir,
+                 cls.max_workers)
         if knobs == cls._validated_knobs:
             return
 
@@ -282,6 +298,12 @@ class EngineConfig:
                 "EngineConfig.decode_workers must be >= 0 (0 disables "
                 f"the decode pool), got {cls.decode_workers!r}")
         positive("decode_pool_inflight", cls.decode_pool_inflight)
+        if cls.cluster_workers < 0:
+            raise ValueError(
+                "EngineConfig.cluster_workers must be >= 0 (0 disables "
+                f"the cluster plane), got {cls.cluster_workers!r}")
+        positive("cluster_inflight_partitions",
+                 cls.cluster_inflight_partitions)
         if cls.durable_dir is not None and (
                 not isinstance(cls.durable_dir, str) or not cls.durable_dir):
             raise ValueError(
@@ -357,6 +379,23 @@ def _run_partition(index: int, batch: pa.RecordBatch,
         telemetry.count(telemetry.M_ENGINE_ROWS_OUT, out.num_rows)
         telemetry.count(telemetry.M_ENGINE_BYTES_OUT, out.nbytes)
     return out
+
+
+def _cluster_dispatch() -> Callable[..., pa.RecordBatch]:
+    """The partition runner for the supervised paths: in-process
+    ``_run_partition`` at the default ``cluster_workers=0`` (the cluster
+    package is never even imported — the byte-identity gate), or the
+    process-wide :meth:`ClusterRouter.run_partition` drop-in when the
+    cluster plane is armed. Resolved once per materialization/stream,
+    not per task. The nested-inline guard paths stay ``_run_partition``
+    unconditionally: a partition task already running ON a cluster
+    worker must not recurse into the coordinator's router."""
+    if not EngineConfig.cluster_workers:
+        return _run_partition
+    from sparkdl_tpu.cluster import router as _cluster_router
+
+    router = _cluster_router.maybe_router()
+    return _run_partition if router is None else router.run_partition
 
 
 def _as_record_batches(table: pa.Table, num_partitions: int) -> List[pa.RecordBatch]:
@@ -494,14 +533,15 @@ class DataFrame:
                 return self._materialized
             sup = PartitionSupervisor(_executor(), _supervisor_config(),
                                       quarantine_probe=self._quarantine_probe)
+            dispatch = _cluster_dispatch()
             # the span is open while tasks are CREATED, so every
             # partition task's trace context parents under it
             with telemetry.span(telemetry.SPAN_MATERIALIZE,
                                 partitions=len(self._partitions),
                                 ops=len(ops)):
                 self._materialized = sup.run_all(
-                    [(i, lambda cancel, i=i, b=b: _run_partition(i, b, ops,
-                                                                 cancel))
+                    [(i, lambda cancel, i=i, b=b: dispatch(i, b, ops,
+                                                           cancel))
                      for i, b in enumerate(self._partitions)])
             return self._materialized
 
@@ -515,14 +555,19 @@ class DataFrame:
             quarantine_probe=lambda i: journal.commit(
                 i, self._quarantine_probe(i), quarantined=True))
 
-    def _durable_runner(self, journal, i: int, ops):
+    def _durable_runner(self, journal, i: int, ops,
+                        dispatch: Callable[..., pa.RecordBatch]
+                        = _run_partition):
         """A partition runner that journals: count the attempt, run the
-        op chain, spill + commit the result before handing it back."""
+        op chain (in-process or via the cluster router — the journal
+        wraps OUTSIDE the dispatch, so a cluster re-dispatch after a
+        worker death is zero-recompute for committed partitions), spill
+        + commit the result before handing it back."""
         b = self._partitions[i]
 
         def run(cancel=None, i=i, b=b):
             journal.note_attempt(i)
-            return journal.commit(i, _run_partition(i, b, ops, cancel))
+            return journal.commit(i, dispatch(i, b, ops, cancel))
 
         return run
 
@@ -537,8 +582,11 @@ class DataFrame:
         results: Dict[int, pa.RecordBatch] = {}
         if todo:
             sup = self._durable_supervisor(journal)
+            dispatch = _cluster_dispatch()
             computed = sup.run_all(
-                [(i, self._durable_runner(journal, i, ops)) for i in todo])
+                [(i, self._durable_runner(journal, i, ops,
+                                          dispatch=dispatch))
+                 for i in todo])
             results.update(zip(todo, computed))
         for i in committed:
             results[i] = journal.load(i)
@@ -553,10 +601,12 @@ class DataFrame:
         ops = self._ops
         todo = [i for i in indices if i not in committed]
         sup = self._durable_supervisor(journal)
+        dispatch = _cluster_dispatch()
 
         def runners():
             for i in todo:
-                yield i, self._durable_runner(journal, i, ops)
+                yield i, self._durable_runner(journal, i, ops,
+                                              dispatch=dispatch)
 
         stream = sup.run_stream(runners(), prefetch=prefetch)
         try:
@@ -669,10 +719,11 @@ class DataFrame:
         sup = PartitionSupervisor(_executor(), _supervisor_config(),
                                   quarantine_probe=self._quarantine_probe)
         parts, ops = self._partitions, self._ops
+        dispatch = _cluster_dispatch()
 
         def runners():
             for i in indices:
-                yield i, (lambda cancel, i=i: _run_partition(
+                yield i, (lambda cancel, i=i: dispatch(
                     i, parts[i], ops, cancel))
 
         yield from sup.run_stream(runners(), prefetch=prefetch)

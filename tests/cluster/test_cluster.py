@@ -1,0 +1,349 @@
+"""Cluster inference plane: partition router, worker processes, merged
+observability (docs/DISTRIBUTED.md "Cluster inference").
+
+The contract under test is ISSUE 14's acceptance list: cluster_workers=0
+leaves every path byte-identical and never imports this package; a
+2-worker run is bit-identical to the in-process run for materialize AND
+stream; worker death re-dispatches precisely and stays bit-identical;
+remote errors keep their resilience classification (retryable retried,
+fatal not); the merged report's health counters equal the sum of the
+per-worker monitors; and the router composes with the durable journal
+(committed partitions are zero-recompute across a cluster run).
+"""
+
+import multiprocessing
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from sparkdl_tpu.cluster import router as cluster_router
+from sparkdl_tpu.core import health, resilience, telemetry
+from sparkdl_tpu.core.health import HealthMonitor
+from sparkdl_tpu.core.telemetry import Telemetry
+from sparkdl_tpu.core.resilience import Fault, FaultInjector
+from sparkdl_tpu.engine import DataFrame, EngineConfig, TaskFailure
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+@pytest.fixture(autouse=True)
+def _restore_engine_config():
+    saved = EngineConfig.snapshot()
+    yield
+    EngineConfig.restore(saved)
+    cluster_router.shutdown()  # idempotent; no test leaks a live router
+
+
+def _frame(n=24, parts=4):
+    return DataFrame.fromRows([{"x": i} for i in range(n)],
+                              numPartitions=parts)
+
+
+def _featurized(n=24, parts=4):
+    """A plan whose op chain crosses the pickle boundary with a captured
+    jax array AND records a worker-side health event per partition — the
+    two things the merged report has to account for."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(5)
+    w = jnp.asarray(rng.normal(size=(1, 3)).astype(np.float32))
+
+    def op(batch):
+        health.record("cluster_probe")
+        x = np.asarray(batch.column("x"), dtype=np.float32).reshape(-1, 1)
+        y = np.asarray(jnp.tanh(x @ w), dtype=np.float32)
+        return pa.array(y.sum(axis=1).astype("float64"))
+
+    return _frame(n, parts).withColumnBatch("y", op,
+                                            outputType=pa.float64())
+
+
+def _assert_no_live_workers(router):
+    assert all(not w.proc.is_alive() for w in router._workers)
+    assert router._pending == {}
+
+
+# -- the gate ----------------------------------------------------------------
+
+def test_workers_zero_never_imports_cluster():
+    """The 0-default must keep the module un-imported, not just unused —
+    pinned in a subprocess because this test session itself imports it."""
+    script = (
+        "import sys\n"
+        "import pyarrow as pa\n"
+        "from sparkdl_tpu.engine import DataFrame, EngineConfig\n"
+        "assert EngineConfig.cluster_workers == 0\n"
+        "df = DataFrame.fromRows([{'x': i} for i in range(8)],"
+        " numPartitions=2)\n"
+        "out = df.withColumnBatch('y',"
+        " lambda b: pa.compute.add(b.column('x'), 1),"
+        " outputType=pa.int64()).collect()\n"
+        "assert [r['y'] for r in out] == [i + 1 for i in range(8)]\n"
+        "rogue = sorted(m for m in sys.modules"
+        " if m.startswith('sparkdl_tpu.cluster'))\n"
+        "assert not rogue, rogue\n"
+        "print('CLEAN')\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=_REPO + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          stdout=subprocess.PIPE,
+                          stderr=subprocess.STDOUT, timeout=240)
+    out = proc.stdout.decode(errors="replace")
+    assert proc.returncode == 0, out[-3000:]
+    assert "CLEAN" in out
+
+
+def test_workers_zero_is_inline_and_routerless():
+    assert EngineConfig.cluster_workers == 0
+    assert cluster_router.maybe_router() is None
+    assert cluster_router._router is None
+    got = _frame(8, 2).collect()
+    assert [r["x"] for r in got] == list(range(8))
+    assert cluster_router._router is None  # the run armed nothing
+
+
+def test_maybe_router_validates_knobs_at_the_read_site():
+    EngineConfig.cluster_workers = -1
+    with pytest.raises(ValueError, match="cluster_workers"):
+        cluster_router.maybe_router()
+    EngineConfig.cluster_workers = 2
+    EngineConfig.cluster_inflight_partitions = 0
+    with pytest.raises(ValueError, match="cluster_inflight_partitions"):
+        cluster_router.maybe_router()
+
+
+# -- parity + merged observability -------------------------------------------
+
+def test_cluster_bit_identical_and_report_proves_health_sums():
+    want_rows = _featurized().collect()
+    want_stream = [b for b in _featurized().streamPartitions()]
+
+    EngineConfig.cluster_workers = 2
+    with HealthMonitor("cluster-parity") as mon, \
+            Telemetry(name="cluster-parity", out_dir="") as tel:
+        try:
+            got_rows = _featurized().collect()
+            got_stream = [b for b in _featurized().streamPartitions()]
+        finally:
+            # shutdown INSIDE the scope: close is the moment the finals
+            # merge, and the merged RunReport needs the active scope
+            cluster_router.shutdown()
+
+    assert got_rows == want_rows  # bit-identical materialize
+    assert len(got_stream) == len(want_stream) == 4
+    for g, w in zip(got_stream, want_stream):
+        assert g.equals(w)  # bit-identical stream, original order
+
+    rep = cluster_router.last_cluster_report()
+    assert rep is not None and rep["worker_count"] == 2
+    # every partition ran on SOME worker, rows fully accounted for
+    assert sum(rep["rows_per_worker"].values()) == 2 * 24
+    assert sum(rep["tasks_per_worker"].values()) == 2 * 4
+    # the acceptance invariant: merged health counters == the sum of the
+    # per-worker monitors, re-derived here independently of aggregate.py
+    manual = {}
+    for snap in rep["workers"].values():
+        assert snap["run_id"] == tel.run_id  # pinned to the coordinator
+        for name, value in snap["health"]["counters"].items():
+            manual[name] = manual.get(name, 0) + value
+    assert rep["health"]["counters"] == manual
+    assert manual["cluster_probe"] == 2 * 4  # one per partition per run
+    assert rep["health_consistent"] is True
+
+    # the merged RunReport carries the cluster section + the run id
+    run_report = cluster_router.last_run_report()
+    assert run_report is not None
+    assert run_report["run_id"] == tel.run_id
+    assert run_report["cluster"]["worker_count"] == 2
+    # coordinator-side lifecycle events stayed coordinator-side
+    assert mon.count(health.CLUSTER_WORKER_STARTED) == 2
+    assert mon.count(health.CLUSTER_WORKER_LOST) == 0
+
+
+# -- resilience semantics across the process boundary ------------------------
+
+def test_remote_errors_keep_their_classification(tmp_path):
+    marker = tmp_path / "fired-once"
+
+    def build(kind):
+        def op(batch):
+            lo = batch.column("x")[0].as_py()
+            if kind == "retryable" and lo == 0 and not marker.exists():
+                marker.write_text("x")  # next attempt succeeds
+                raise resilience.WorkerFault(
+                    "injected worker-side retryable loss")
+            if kind == "fatal" and lo == 0:
+                raise ValueError("deliberately malformed partition")
+            return pa.compute.add(batch.column("x"), 1)
+
+        return _frame(8, 2).withColumnBatch("y", op,
+                                            outputType=pa.int64())
+
+    EngineConfig.cluster_workers = 2
+    try:
+        with HealthMonitor("cluster-retry") as mon:
+            got = build("retryable").collect()
+        assert [r["y"] for r in got] == [i + 1 for i in range(8)]
+        assert marker.exists()
+        assert mon.count(health.TASK_RETRIED) == 1
+        assert mon.count(health.TASK_FAILED) == 0
+
+        with HealthMonitor("cluster-fatal") as mon:
+            with pytest.raises(TaskFailure, match="fatal"):
+                build("fatal").collect()
+        assert mon.count(health.TASK_RETRIED) == 0  # fatal: never retried
+        assert mon.count(health.TASK_FAILED) == 1
+    finally:
+        cluster_router.shutdown()
+
+
+def test_worker_death_redispatches_precisely_and_stays_bit_identical():
+    want = _featurized(36, 6).collect()
+
+    def _segments():
+        if not os.path.isdir("/dev/shm"):
+            return set()
+        return {n for n in os.listdir("/dev/shm") if n.startswith("psm_")}
+
+    before = _segments()
+    EngineConfig.cluster_workers = 2
+    inj = FaultInjector.seeded(0, cluster_worker_kill=Fault(times=1,
+                                                            after=2))
+    try:
+        with inj, HealthMonitor("cluster-chaos") as mon:
+            got = _featurized(36, 6).collect()
+    finally:
+        cluster_router.shutdown()
+
+    assert inj.fired == {"cluster_worker_kill": 1}
+    assert got == want  # bit-identical THROUGH the worker loss
+    assert mon.count(health.CLUSTER_WORKER_STARTED) == 2
+    assert mon.count(health.CLUSTER_WORKER_LOST) == 1  # one death, one event
+    # the killed worker held at least the partition whose dispatch armed
+    # the kill; each moved partition is one redispatch event
+    assert mon.count(health.CLUSTER_REDISPATCH) >= 1
+
+    router = cluster_router._last_router
+    _assert_no_live_workers(router)
+    assert _segments() - before == set()  # no leaked shm segments
+    # the survivor's final snapshot still merged (the dead worker cannot
+    # ship one — worker_count counts snapshots, not spawns); the dead
+    # worker's pre-death completions died with it, so the survivor
+    # accounts for everything it ran: at least the re-dispatched work
+    rep = cluster_router.last_cluster_report()
+    assert rep["worker_count"] == 1
+    assert 0 < sum(rep["rows_per_worker"].values()) <= 36
+
+
+def test_no_survivors_fails_retryable():
+    router = cluster_router.ClusterRouter(workers=1)
+    try:
+        ops = [lambda b: b]
+        token = router._ops_payload(ops)
+        batch = pa.record_batch([pa.array([1, 2, 3])], names=["x"])
+        with FaultInjector.seeded(0, cluster_worker_kill=1):
+            task = router._submit(0, batch, token)
+            with pytest.raises(resilience.ClusterWorkerLost) as ei:
+                router._await(task, None)
+        # the supervisor's retry loop sees a RETRYABLE kind — workers
+        # coming back (or a redundant cluster) makes the retry land
+        assert resilience.classify(ei.value) == resilience.RETRYABLE
+        # with zero survivors a fresh dispatch fails the same way
+        with pytest.raises(resilience.ClusterWorkerLost):
+            router._submit(1, batch, token)
+    finally:
+        router.close()
+    _assert_no_live_workers(router)
+
+
+def test_hedge_antiaffinity_and_load_aware_spread():
+    router = cluster_router.ClusterRouter(workers=2)
+    try:
+        # a slow op keeps submitted tasks in-flight long enough that the
+        # worker-selection assertions below are deterministic, not a
+        # race against the worker's round-trip
+        def slow(b):
+            import time
+            time.sleep(0.5)
+            return b
+
+        token = router._ops_payload([slow])
+        batch = pa.record_batch([pa.array([1, 2, 3])], names=["x"])
+        # two concurrent attempts of the SAME partition (a hedge) must
+        # land on different workers
+        t1 = router._submit(7, batch, token)
+        t2 = router._submit(7, batch, token)
+        assert t1.worker != t2.worker
+        assert router._await(t1, None).equals(batch)
+        assert router._await(t2, None).equals(batch)
+        # load-aware spread: with t3 outstanding on one worker, the next
+        # distinct partition goes to the idle one
+        t3 = router._submit(8, batch, token)
+        t4 = router._submit(9, batch, token)
+        assert t3.worker != t4.worker
+        router._await(t3, None)
+        router._await(t4, None)
+    finally:
+        router.close()
+    router.close()  # idempotent
+    _assert_no_live_workers(router)
+    assert router.cluster_report["worker_count"] == 2
+
+
+# -- lifecycle + composition -------------------------------------------------
+
+def test_maybe_router_lifecycle_follows_the_knobs():
+    EngineConfig.cluster_workers = 1
+    try:
+        r1 = cluster_router.maybe_router()
+        assert r1 is not None and r1.workers == 1
+        assert cluster_router.maybe_router() is r1  # cached while knobs hold
+        EngineConfig.cluster_inflight_partitions = 3
+        r2 = cluster_router.maybe_router()
+        assert r2 is not r1 and r2.inflight == 3
+        assert r1.closed  # reconfigure closed (and merged) the old router
+    finally:
+        cluster_router.shutdown()
+    assert r2.closed
+    assert cluster_router._router is None
+    assert cluster_router.last_cluster_report() is not None
+    _assert_no_live_workers(r2)
+    # no stray cluster children anywhere after shutdown
+    names = [p.name for p in multiprocessing.active_children()]
+    assert not any(n.startswith("sparkdl-cluster") for n in names), names
+
+
+def test_durable_journal_composes_with_cluster(tmp_path):
+    """PR 11 x PR 14: the journal wraps OUTSIDE the router, so a second
+    cluster run of the same plan restores every partition from spill —
+    zero worker-side re-execution."""
+    EngineConfig.durable_dir = str(tmp_path / "durable")
+    EngineConfig.cluster_workers = 2
+    trace = tmp_path / "executions.log"
+
+    def build():
+        def op(batch):
+            with open(trace, "a") as f:  # worker-side side effect
+                f.write(f"{batch.column('x')[0].as_py()}\n")
+            return pa.compute.add(batch.column("x"), 1)
+
+        return _frame(12, 4).withColumnBatch("y", op,
+                                             outputType=pa.int64())
+
+    try:
+        want = build().collect()
+        assert len(trace.read_text().splitlines()) == 4
+        with HealthMonitor("cluster-durable") as mon:
+            got = build().collect()  # fresh frame, same plan -> same job
+    finally:
+        cluster_router.shutdown()
+
+    assert got == want
+    assert len(trace.read_text().splitlines()) == 4  # zero recompute
+    assert len(mon.events(health.DURABLE_PARTITION_RESTORED)) == 4

@@ -762,6 +762,9 @@ def test_shutdown_racing_concurrent_submits_never_hangs_or_leaks():
     ("inference_donate_buffers", 1),
     ("bucket_ladder", "adaptive"),
     ("bucket_ladder", None),
+    ("cluster_workers", -1),
+    ("cluster_inflight_partitions", 0),
+    ("cluster_inflight_partitions", -3),
     ("max_workers", 0),
 ])
 def test_engine_config_validation_rejects(knob, value):

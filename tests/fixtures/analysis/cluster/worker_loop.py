@@ -1,0 +1,20 @@
+"""Seeded violations for the cluster inference plane: a worker process
+spawned unnamed and never joined (thread-lifecycle — a died-silently
+cluster worker is undebuggable without a name, unreapable without a
+join path), and a worker loop entering the device directly instead of
+through its per-process executor (executor-choke-point; the `cluster/`
+path segment puts this in scope — bypassing the executor loses
+coalescing, admission control and the compiled-fn cache the per-worker
+stack exists to provide)."""
+
+import multiprocessing
+
+
+def spawn_worker(loop):
+    proc = multiprocessing.Process(target=loop)
+    proc.start()
+    return proc
+
+
+def run_chain(model, batch):
+    return model.apply_batch(batch, batch_size=32)

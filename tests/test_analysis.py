@@ -79,6 +79,8 @@ EXPECTED_FIXTURE_RULES = {
     "ml/choke_point.py": {"executor-choke-point"},
     "ml/precision_donation.py": {"executor-choke-point"},
     "serving/hot_path.py": {"executor-choke-point"},
+    "cluster/worker_loop.py": {"executor-choke-point",
+                               "thread-lifecycle"},
     "trainer_fetch.py": {"blocking-fetch-in-fit"},
     "span_name_typo.py": {"span-names"},
     "health_bare_string.py": {"health-constants"},

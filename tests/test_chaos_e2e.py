@@ -346,6 +346,69 @@ def test_chaos_pipeline_with_decode_pool_bit_identical(image_dir, tmp_path):
     assert mon.count(health.DECODE_POOL_RESPAWN) == 0
 
 
+def test_chaos_cluster_worker_kill_bit_identical(image_dir):
+    """ISSUE 14 acceptance: the files→decode→featurize leg with the
+    cluster plane armed (EngineConfig.cluster_workers=2) and ONE worker
+    SIGKILLed mid-stream by the armed `cluster_worker_kill` injection —
+    the run completes bit-identical to the in-process run, the death is
+    exactly one `cluster_worker_lost` with its held partitions
+    re-dispatched, and nothing leaks (no live worker processes, no
+    shared-memory segments)."""
+    import multiprocessing
+    import os
+
+    from sparkdl_tpu.cluster import router as cluster_router
+
+    def featurize():
+        df = imageIO.readImages(str(image_dir), numPartition=1)
+        df = df.withColumn(
+            "label", lambda p: int(re.search(r"img_(\d+)", p).group(1)) % 2,
+            ["filePath"], pa.int64())
+        df = df.repartition(3)
+        t = TPUImageTransformer(inputCol="image", outputCol="features",
+                                modelFunction=_feature_model(), batchSize=8,
+                                outputMode="vector")
+        rows = t.transform(df).select("features", "label").collect()
+        x = np.asarray([r["features"] for r in rows], dtype=np.float32)
+        y = np.asarray([r["label"] for r in rows], dtype=np.int64)
+        return x, y
+
+    def shm_segments():
+        if not os.path.isdir("/dev/shm"):
+            return set()
+        return {n for n in os.listdir("/dev/shm") if n.startswith("psm_")}
+
+    x0, y0 = featurize()  # in-process truth (cluster_workers=0)
+
+    before = shm_segments()
+    EngineConfig.cluster_workers = 2
+    # dispatch #1 is the decode partition; the kill arms on dispatch #2 —
+    # the first transform partition, with the stream mid-flight
+    inj = FaultInjector.seeded(0, cluster_worker_kill=Fault(times=1,
+                                                            after=1))
+    try:
+        with inj, HealthMonitor("chaos-cluster") as mon:
+            x1, y1 = featurize()
+    finally:
+        cluster_router.shutdown()
+
+    assert inj.fired == {"cluster_worker_kill": 1}
+    np.testing.assert_array_equal(x1, x0)  # bit-identical through the kill
+    np.testing.assert_array_equal(y1, y0)
+    assert mon.count(health.CLUSTER_WORKER_STARTED) == 2
+    assert mon.count(health.CLUSTER_WORKER_LOST) == 1  # ONE death event
+    assert mon.count(health.CLUSTER_REDISPATCH) >= 1  # its held partitions
+    assert mon.count(health.TASK_FAILED) == 0  # survivors absorbed it all
+
+    # zero leaks: every worker process reaped, no stray cluster children,
+    # no shared-memory segments beyond what preceded the run
+    router = cluster_router._last_router
+    assert all(not w.proc.is_alive() for w in router._workers)
+    names = [p.name for p in multiprocessing.active_children()]
+    assert not any(n.startswith("sparkdl-cluster") for n in names), names
+    assert shm_segments() - before == set()
+
+
 def test_chaos_pipeline_bf16_tuned_ladder_within_tolerance(image_dir,
                                                            tmp_path):
     """ISSUE 12 acceptance: the FULL 5-fault chaos run with the raw-speed
