@@ -189,7 +189,12 @@ class BlockingFetchInFitRule(Rule):
 # span-names (ISSUE 4)
 # ---------------------------------------------------------------------------
 
-_SPAN_CALL_NAMES = {"annotate", "span"}
+#: ``remote_span``/``record_remote`` carry span names ACROSS a process
+#: boundary (decode-pool / cluster messages): a non-canonical name there
+#: is unmergeable on the adopting side, so the lint covers them too —
+#: the static half of the runtime rejection in ``Tracer.record_remote``
+#: / ``adopt_remote_spans``.
+_SPAN_CALL_NAMES = {"annotate", "span", "remote_span", "record_remote"}
 
 
 def _resolve_span_name(arg: ast.expr) -> Optional[str]:
@@ -230,11 +235,14 @@ def span_names_in(tree: ast.AST) -> List[Tuple[str, int]]:
 @register
 class SpanNamesRule(Rule):
     id = "span-names"
-    title = "annotate()/span() names must be canonical"
+    title = "annotate()/span()/remote_span() names must be canonical"
     rationale = (
         "A typo'd phase name silently forks a timer and a trace track "
-        "instead of failing. Every literal or module-constant name "
-        "must be declared in core.telemetry.CANONICAL_SPAN_NAMES "
+        "instead of failing, and a non-canonical name shipped across a "
+        "process boundary (remote_span/record_remote) is REJECTED by "
+        "the adopting tracer — the span vanishes from the merged "
+        "timeline. Every literal or module-constant name must be "
+        "declared in core.telemetry.CANONICAL_SPAN_NAMES "
         "(docs/OBSERVABILITY.md is the human catalog); dynamic names "
         "are not checkable and are skipped.")
 

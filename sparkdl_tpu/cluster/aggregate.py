@@ -36,13 +36,18 @@ __all__ = ["build_snapshot", "merge_snapshots", "merged_run_report",
 
 def build_snapshot(worker: str, pid: int, tel: Any, monitor: Any, *,
                    tasks: int, rows: int, exec_s: float,
-                   phases: Optional[Dict[str, Any]] = None
+                   phases: Optional[Dict[str, Any]] = None,
+                   span_ring: Optional[Dict[str, Any]] = None
                    ) -> Dict[str, Any]:
     """One worker's end-of-run snapshot (worker-side, while its
     telemetry scope and health monitor are still active): the same
     ingredients ``RunReport.build`` uses, JSON-able, small enough to
-    ship over the result pipe."""
-    return {
+    ship over the result pipe. With cross-process tracing armed,
+    ``span_ring`` is :meth:`Tracer.export_ring`'s shippable view of the
+    worker's spans (rebased onto the coordinator's clock); the key is
+    absent entirely when tracing is off, keeping the off-path snapshot
+    byte-identical."""
+    snap = {
         "worker": worker,
         "pid": pid,
         "run_id": tel.run_id,
@@ -54,6 +59,9 @@ def build_snapshot(worker: str, pid: int, tel: Any, monitor: Any, *,
         "trace": tel.tracer.summary(),
         "phases": dict(phases or {}),
     }
+    if span_ring is not None:
+        snap["span_ring"] = span_ring
+    return snap
 
 
 def sum_canonical_counters(snapshots: Sequence[Dict[str, Any]]
@@ -85,7 +93,8 @@ def sum_health_counters(snapshots: Sequence[Dict[str, Any]]
     return dict(sorted(totals.items()))
 
 
-def merge_snapshots(snapshots: Sequence[Dict[str, Any]]
+def merge_snapshots(snapshots: Sequence[Dict[str, Any]],
+                    lost_workers: Sequence[str] = ()
                     ) -> Dict[str, Any]:
     """Fold per-worker snapshots into ONE ``cluster`` report section.
 
@@ -95,6 +104,13 @@ def merge_snapshots(snapshots: Sequence[Dict[str, Any]]
     the worker monitors — with ``health_consistent`` proving that sum
     equals the independently-accumulated ``sparkdl.health.*`` metric
     mirrors, event for event.
+
+    With cross-process tracing armed (any snapshot carrying a
+    ``span_ring``), a ``trace`` subsection records spans shipped and
+    dropped PER WORKER — ring truncation is visible in the report, not
+    silent — plus one ``span_rings_lost`` entry per worker that died
+    without shipping its final snapshot (``lost_workers``, from the
+    router). Off-path reports keep their exact pre-tracing shape.
     """
     snapshots = [s for s in snapshots if s]
     health_totals = sum_health_counters(snapshots)
@@ -103,7 +119,7 @@ def merge_snapshots(snapshots: Sequence[Dict[str, Any]]
     mirrored = {name[len(prefix):]: int(value)
                 for name, value in counters.items()
                 if name.startswith(prefix)}
-    return {
+    out = {
         "worker_count": len(snapshots),
         "workers": {s["worker"]: s for s in snapshots},
         "counters": counters,
@@ -116,12 +132,27 @@ def merge_snapshots(snapshots: Sequence[Dict[str, Any]]
         "exec_s_per_worker": {s["worker"]: s.get("exec_s", 0.0)
                               for s in snapshots},
     }
+    if any(s.get("span_ring") is not None for s in snapshots):
+        out["trace"] = {
+            "workers": {
+                s["worker"]: {
+                    "shipped": len(s["span_ring"]["spans"]),
+                    "dropped": s["span_ring"]["dropped"],
+                    "clock_offset_ns": s["span_ring"]["clock_offset_ns"],
+                }
+                for s in snapshots if s.get("span_ring") is not None},
+            "span_rings_lost": sorted(lost_workers),
+        }
+    return out
 
 
 def merged_run_report(tel: Any, snapshots: Sequence[Dict[str, Any]],
-                      health_monitor: Any = None) -> Dict[str, Any]:
+                      health_monitor: Any = None,
+                      lost_workers: Sequence[str] = ()
+                      ) -> Dict[str, Any]:
     """The coordinator's normal ``RunReport`` plus the merged
     ``cluster`` section — one artifact for the whole cluster run."""
     report = telemetry.RunReport.build(tel, health_monitor)
-    report["cluster"] = merge_snapshots(snapshots)
+    report["cluster"] = merge_snapshots(snapshots,
+                                        lost_workers=lost_workers)
     return report

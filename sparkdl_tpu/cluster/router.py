@@ -112,16 +112,20 @@ class _Task:
     """One in-flight partition dispatch: the wire payload plus
     everything needed to re-dispatch it after a worker death."""
 
-    __slots__ = ("task_id", "index", "token", "payload", "rows", "event",
-                 "result", "error", "worker", "redispatches")
+    __slots__ = ("task_id", "index", "token", "payload", "rows", "ctx",
+                 "event", "result", "error", "worker", "redispatches")
 
     def __init__(self, index: int, token: str, payload: bytes,
-                 rows: int) -> None:
+                 rows: int, ctx=None) -> None:
         self.task_id = 0
         self.index = index
         self.token = token
         self.payload = payload
         self.rows = rows
+        # the dispatch span's context, captured at submit: rides every
+        # (re-)dispatch of this task so the worker-side span parents
+        # under the SAME coordinator span a hedge/redispatch belongs to
+        self.ctx = ctx
         self.event = threading.Event()
         self.result: Any = None
         self.error: Optional[BaseException] = None
@@ -134,14 +138,16 @@ class _Worker:
     result pipe, the op-chain tokens already shipped to it, and its
     in-flight task ids / outstanding rows (the load signal)."""
 
-    __slots__ = ("wid", "proc", "queue", "conn", "assigned", "tokens",
-                 "outstanding_rows", "finished", "lost")
+    __slots__ = ("wid", "proc", "queue", "conn", "clock", "assigned",
+                 "tokens", "outstanding_rows", "finished", "lost")
 
-    def __init__(self, wid: int, proc: Any, queue: Any, conn: Any) -> None:
+    def __init__(self, wid: int, proc: Any, queue: Any, conn: Any,
+                 clock: Any) -> None:
         self.wid = wid
         self.proc = proc
         self.queue = queue
         self.conn = conn  # parent's read end; None once EOF-drained
+        self.clock = clock  # clock-handshake pipe; None once answered
         self.assigned: Set[int] = set()
         self.tokens: Set[str] = set()
         self.outstanding_rows = 0
@@ -200,8 +206,13 @@ class ClusterRouter:
                       durable_dir=None)
         import cloudpickle
 
+        # the coordinator's root span context ships in the boot blob:
+        # worker-side ambient spans (compiles, executor launches) parent
+        # under it instead of dangling off the worker's private root —
+        # None (tracing off) keeps the worker's trace fully local
         self._boot_blob = cloudpickle.dumps(
-            {"config": config, "platform": jax.default_backend()})
+            {"config": config, "platform": jax.default_backend(),
+             "root_ctx": tel.root_context if tel is not None else None})
         self._lock = threading.Lock()
         self._pending: Dict[int, _Task] = {}
         self._ids = itertools.count(1)
@@ -233,6 +244,8 @@ class ClusterRouter:
                 worker.queue.cancel_join_thread()
                 worker.queue.close()
                 worker.conn.close()
+                if worker.clock is not None:
+                    worker.clock.close()
             self._wake_r.close()
             self._wake_w.close()
             self._closed = True
@@ -245,17 +258,22 @@ class ClusterRouter:
     def _spawn(self, index: int) -> _Worker:
         queue = _MP_CTX.Queue()
         recv_conn, send_conn = _MP_CTX.Pipe(duplex=False)
+        # dedicated duplex pipe for the one-shot clock handshake: the
+        # collector answers the worker's ping with perf_counter_ns so
+        # remote span timestamps land on the coordinator's timeline
+        clock_parent, clock_child = _MP_CTX.Pipe()
         proc = _MP_CTX.Process(
             target=_worker_mod._worker_main,
             args=(index, queue, send_conn, os.getpid(), self.run_id,
-                  self._boot_blob),
+                  self._boot_blob, clock_child),
             name=f"sparkdl-cluster-{index}", daemon=True)
         proc.start()
         # drop the parent's copy of the write end: the worker owns the
         # only writer, so worker death shows up as EOF on recv_conn
         send_conn.close()
+        clock_child.close()
         health.record(health.CLUSTER_WORKER_STARTED, worker=proc.name)
-        return _Worker(index, proc, queue, recv_conn)
+        return _Worker(index, proc, queue, recv_conn, clock_parent)
 
     @property
     def closed(self) -> bool:
@@ -347,7 +365,8 @@ class ClusterRouter:
                 raise resilience.ClusterWorkerLost(
                     "cluster router closed while a dispatch was waiting "
                     "for an in-flight slot")
-        task = _Task(index, token, payload, batch.num_rows)
+        task = _Task(index, token, payload, batch.num_rows,
+                     telemetry.current_context())
         with self._lock:
             if self._closed:
                 self._sem.release()
@@ -393,7 +412,7 @@ class ClusterRouter:
         crash = resilience.should_fire("cluster_worker_kill",
                                        partition=task.index)
         worker.queue.put(("task", task.task_id, task.index, task.token,
-                          task.payload, crash))
+                          task.payload, crash, task.ctx))
         worker.assigned.add(task.task_id)
         worker.outstanding_rows += task.rows
         task.worker = worker.wid
@@ -453,15 +472,33 @@ class ClusterRouter:
             with self._lock:
                 conn_map = {w.conn: w for w in self._workers
                             if w.conn is not None}
-                done = self._closed and not conn_map
+                clock_map = {w.clock: w for w in self._workers
+                             if w.clock is not None}
+                done = self._closed and not conn_map and not clock_map
             if done:
                 return
-            for ready in _mpc.wait(list(conn_map) + [self._wake_r]):
+            for ready in _mpc.wait(list(conn_map) + list(clock_map)
+                                   + [self._wake_r]):
                 if ready is self._wake_r:
                     try:
                         self._wake_r.recv_bytes()
                     except (EOFError, OSError):  # pragma: no cover
                         pass
+                    continue
+                if ready in clock_map:
+                    # one-shot clock handshake: answer the worker's ping
+                    # with the coordinator's perf_counter_ns, then
+                    # retire the pipe (EOF = the worker died first)
+                    try:
+                        ready.recv()
+                        ready.send(time.perf_counter_ns())
+                    except (EOFError, OSError):
+                        pass
+                    ready.close()
+                    with self._lock:
+                        clocked = clock_map[ready]
+                        if clocked.clock is ready:
+                            clocked.clock = None
                     continue
                 worker = conn_map[ready]
                 try:
@@ -600,10 +637,21 @@ class ClusterRouter:
         with self._lock:
             finals = list(self._finals)
         self.worker_snapshots = finals
-        self.cluster_report = aggregate.merge_snapshots(finals)
+        lost = [w.proc.name for w in workers if w.lost]
         tel = telemetry.active()
-        self.run_report = (aggregate.merged_run_report(tel, finals)
-                           if tel is not None else None)
+        if tel is not None:
+            # merge the worker span rings into the coordinator's tracer
+            # BEFORE building the reports, so the Chrome trace and the
+            # trace summary both see every adopted span
+            for snap in finals:
+                ring = snap.get("span_ring")
+                if ring is not None:
+                    tel.tracer.adopt_remote_spans(ring["spans"])
+        self.cluster_report = aggregate.merge_snapshots(
+            finals, lost_workers=lost)
+        self.run_report = (
+            aggregate.merged_run_report(tel, finals, lost_workers=lost)
+            if tel is not None else None)
 
     def __enter__(self) -> "ClusterRouter":
         return self

@@ -31,11 +31,17 @@ pools under the coordinator's pool).
 
 Protocol (parent -> worker queue):
   ``("ops", token, blob)``                      register an op chain
-  ``("task", task_id, index, token, ipc, crash)``  run one partition
+  ``("task", task_id, index, token, ipc, crash, ctx)``  run one
+      partition; ``ctx`` is the coordinator's dispatch-span
+      ``SpanContext`` (None with tracing off) — the worker's
+      ``sparkdl.cluster_task`` span parents under it
   ``None``                                      poison pill
 (worker -> parent pipe):
   ``("ok", task_id, ipc, meta)`` / ``("err", task_id, type, msg, kind)``
   ``("final", worker_id, snapshot)``            last message before EOF
+      (with tracing armed the snapshot carries this worker's span ring,
+      rebased onto the coordinator's clock via the startup handshake on
+      the dedicated clock pipe)
 """
 
 from __future__ import annotations
@@ -87,7 +93,8 @@ def _batch_from_ipc(payload: bytes) -> Any:
 
 
 def _worker_main(worker_id: int, tasks: Any, conn: Any, owner_pid: int,
-                 run_id: str, boot_blob: bytes) -> None:
+                 run_id: str, boot_blob: bytes,
+                 clock_conn: Any = None) -> None:
     """Worker process loop: execute partition op chains until the
     ``None`` poison pill, then ship the end-of-run snapshot and EOF.
 
@@ -118,6 +125,15 @@ def _worker_main(worker_id: int, tasks: Any, conn: Any, owner_pid: int,
 
     EngineConfig.restore(boot["config"])
     name = f"sparkdl-cluster-{worker_id}"
+    # the coordinator's root span context (None = tracing off) and the
+    # clock offset that maps this process's perf_counter_ns onto the
+    # coordinator's — together they let this worker's spans merge onto
+    # the coordinator's timeline as ONE trace
+    coord_root = boot.get("root_ctx")
+    clock_offset = 0
+    if clock_conn is not None:
+        clock_offset = telemetry.clock_handshake(clock_conn)
+        clock_conn.close()
     ops_cache: Dict[str, Any] = {}
     tasks_done = 0
     rows_out = 0
@@ -128,7 +144,12 @@ def _worker_main(worker_id: int, tasks: Any, conn: Any, owner_pid: int,
     # the snapshot ships over the pipe instead
     monitor = health.HealthMonitor(name)
     with monitor, telemetry.Telemetry(
-            name=name, out_dir="", run_id=run_id) as tel:
+            name=name, out_dir="", run_id=run_id,
+            process_scope=f"w{worker_id}") as tel:
+        # ambient worker spans (compiles, executor launches) parent
+        # under the coordinator's root rather than this worker's private
+        # root — a no-op when tracing is off (coord_root is None)
+        telemetry.attach(coord_root)
         while True:
             try:
                 msg = tasks.get(timeout=_ORPHAN_POLL_S)
@@ -143,7 +164,7 @@ def _worker_main(worker_id: int, tasks: Any, conn: Any, owner_pid: int,
                 _, token, blob = msg
                 ops_cache[token] = cloudpickle.loads(blob)
                 continue
-            _, task_id, index, token, payload, crash = msg
+            _, task_id, index, token, payload, crash, ctx = msg
             if crash:
                 # injected worker death (chaos leg): die as hard as a
                 # machine loss — no cleanup, no final snapshot
@@ -152,7 +173,12 @@ def _worker_main(worker_id: int, tasks: Any, conn: Any, owner_pid: int,
             try:
                 ops = ops_cache[token]
                 out = _batch_from_ipc(payload)
-                with telemetry.span(telemetry.SPAN_TASK, partition=index,
+                # parent = the coordinator's sparkdl.cluster_dispatch
+                # span that shipped this task (ambient fallback when
+                # tracing is off), so the cross-process parent link is
+                # explicit, not inferred
+                with telemetry.span(telemetry.SPAN_CLUSTER_TASK,
+                                    parent=ctx, partition=index,
                                     cluster_worker=worker_id):
                     for op in ops:
                         out = op(out)
@@ -168,10 +194,20 @@ def _worker_main(worker_id: int, tasks: Any, conn: Any, owner_pid: int,
             exec_s_total += dt
             conn.send(("ok", task_id, result,
                        {"exec_s": dt, "rows": out.num_rows}))
-        # end-of-run snapshot, built while the scopes are still active
+        # end-of-run snapshot, built while the scopes are still active;
+        # with tracing armed it carries this worker's span ring, rebased
+        # onto the coordinator's clock, with spans still hanging off the
+        # worker's (never-shipped, still-open) root re-parented onto the
+        # coordinator's root
+        span_ring = None
+        if coord_root is not None:
+            span_ring = tel.tracer.export_ring(
+                clock_offset_ns=clock_offset, process=name,
+                parent_remap={tel.root_context.span_id:
+                              coord_root.span_id})
         snapshot = aggregate.build_snapshot(
             name, os.getpid(), tel, monitor, tasks=tasks_done,
             rows=rows_out, exec_s=exec_s_total,
-            phases=profiling.phase_stats())
+            phases=profiling.phase_stats(), span_ring=span_ring)
     conn.send(("final", worker_id, snapshot))
     conn.close()

@@ -253,7 +253,8 @@ def sweep_orphaned_segments() -> int:
     return swept
 
 
-def _worker_main(tasks: Any, conn: Any, owner_pid: int) -> None:
+def _worker_main(tasks: Any, conn: Any, owner_pid: int,
+                 clock_conn: Any = None) -> None:
     """Worker process loop: decode chunks until the ``None`` poison pill.
 
     Runs in a fresh spawn interpreter: ``sparkdl_tpu.core`` is lazy, so
@@ -277,6 +278,14 @@ def _worker_main(tasks: Any, conn: Any, owner_pid: int) -> None:
     _IN_WORKER = True
     from sparkdl_tpu.image import imageIO  # one heavy import per worker
 
+    # one NTP-style round trip against the parent's perf_counter_ns so
+    # chunk spans measured here land on the coordinator's timeline
+    # (offset 0 if the parent never answers — see clock_handshake)
+    clock_offset = 0
+    if clock_conn is not None:
+        clock_offset = telemetry.clock_handshake(clock_conn)
+        clock_conn.close()
+
     while True:
         try:
             task = tasks.get(timeout=_ORPHAN_POLL_S)
@@ -288,10 +297,10 @@ def _worker_main(tasks: Any, conn: Any, owner_pid: int) -> None:
         if task is None:
             conn.close()
             return
-        task_id, blobs, target_size, channels, crash = task
+        task_id, blobs, target_size, channels, crash, ctx = task
         if crash:
             os._exit(1)  # injected worker crash: die without cleanup
-        t0 = time.perf_counter()
+        t0_ns = time.perf_counter_ns()
         try:
             arrays = imageIO.decodePoolChunk(
                 blobs, target_size=target_size, channels=channels)
@@ -299,24 +308,33 @@ def _worker_main(tasks: Any, conn: Any, owner_pid: int) -> None:
         except Exception as e:  # noqa: BLE001 - re-raised parent-side
             conn.send((task_id, {"error": (type(e).__name__, str(e))}))
             continue
-        per_blob = (time.perf_counter() - t0) / max(1, len(blobs))
-        conn.send((task_id,
-                   _pack_result(arrays, [per_blob] * len(blobs),
-                                owner_pid)))
+        t1_ns = time.perf_counter_ns()
+        per_blob = (t1_ns - t0_ns) / 1e9 / max(1, len(blobs))
+        result = _pack_result(arrays, [per_blob] * len(blobs), owner_pid)
+        if ctx is not None:
+            # a ctx rides the task only when the submitter had an active
+            # trace; timestamps rebased onto the parent's clock here so
+            # the adopting side never needs this worker's offset
+            result["span"] = telemetry.remote_span(
+                telemetry.SPAN_DECODE_CHUNK,
+                t0_ns + clock_offset, t1_ns + clock_offset,
+                blobs=len(blobs))
+        conn.send((task_id, result))
 
 
 class _Chunk:
     """One fan-out unit: a contiguous slice of a decode call's blobs,
     plus everything needed to resubmit it after a worker crash."""
 
-    __slots__ = ("blobs", "target_size", "channels", "event", "result",
-                 "error", "attempts")
+    __slots__ = ("blobs", "target_size", "channels", "ctx", "event",
+                 "result", "error", "attempts")
 
     def __init__(self, blobs: List[Optional[bytes]], target_size,
-                 channels) -> None:
+                 channels, ctx=None) -> None:
         self.blobs = blobs
         self.target_size = target_size
         self.channels = channels
+        self.ctx = ctx  # submitter's span context; None when tracing off
         self.event = threading.Event()
         self.result: Optional[List[Optional[np.ndarray]]] = None
         self.error: Optional[BaseException] = None
@@ -354,12 +372,14 @@ class _Worker:
     is no shared result-queue write lock to die holding), which the
     collector sees as EOF and the reaper turns into a respawn."""
 
-    __slots__ = ("proc", "queue", "conn", "assigned")
+    __slots__ = ("proc", "queue", "conn", "clock", "assigned")
 
-    def __init__(self, proc: Any, queue: Any, conn: Any) -> None:
+    def __init__(self, proc: Any, queue: Any, conn: Any,
+                 clock: Any) -> None:
         self.proc = proc
         self.queue = queue
         self.conn = conn  # parent's read end; None once EOF-drained
+        self.clock = clock  # clock-handshake pipe; None once answered
         self.assigned: set = set()
 
 
@@ -407,6 +427,9 @@ class DecodePool:
         # — with live shared-memory names — that are still buffered in
         # its pipe, and dropping the conn would leak the segments
         self._retired_conns: List[Any] = []
+        # clock pipes of reaped workers: drained to EOF by the collector
+        # (a worker may die before pinging, or with a ping buffered)
+        self._retired_clocks: List[Any] = []
         # incremental append (not a comprehension): a spawn failing at
         # worker k must leave workers 0..k-1 reachable so the cleanup
         # below can poison/join them instead of leaking live processes
@@ -421,6 +444,8 @@ class DecodePool:
                 worker.queue.cancel_join_thread()
                 worker.queue.close()
                 worker.conn.close()
+                if worker.clock is not None:
+                    worker.clock.close()
             self._wake_r.close()
             self._wake_w.close()
             self._closed = True
@@ -433,14 +458,19 @@ class DecodePool:
     def _spawn(self, index: int) -> _Worker:
         queue = _MP_CTX.Queue()
         recv_conn, send_conn = _MP_CTX.Pipe(duplex=False)
+        # dedicated duplex pipe for the one-shot clock handshake: the
+        # collector answers the worker's ping with perf_counter_ns
+        clock_parent, clock_child = _MP_CTX.Pipe()
         proc = _MP_CTX.Process(
-            target=_worker_main, args=(queue, send_conn, os.getpid()),
+            target=_worker_main,
+            args=(queue, send_conn, os.getpid(), clock_child),
             name=f"sparkdl-decode-{index}", daemon=True)
         proc.start()
         # drop the parent's copy of the write end: the worker owns the
         # only writer, so worker death shows up as EOF on recv_conn
         send_conn.close()
-        return _Worker(proc, queue, recv_conn)
+        clock_child.close()
+        return _Worker(proc, queue, recv_conn, clock_parent)
 
     @property
     def closed(self) -> bool:
@@ -485,7 +515,8 @@ class DecodePool:
                     "decode pool closed while a submit was waiting for "
                     "an in-flight slot")
             self._reap_crashed()
-        chunk = _Chunk(blobs, target_size, channels)
+        chunk = _Chunk(blobs, target_size, channels,
+                       telemetry.current_context())
         with self._lock:
             if self._closed:
                 self._sem.release()
@@ -511,7 +542,7 @@ class DecodePool:
         worker.assigned.add(task_id)
         crash = resilience.should_fire("decode_pool_worker_crash")
         worker.queue.put((task_id, chunk.blobs, chunk.target_size,
-                          chunk.channels, crash))
+                          chunk.channels, crash, chunk.ctx))
 
     def _await(self, chunk: _Chunk) -> List[Optional[np.ndarray]]:
         while not chunk.event.wait(_WAIT_POLL_S):
@@ -551,6 +582,10 @@ class DecodePool:
                     # buffered results (and their shm segments) must
                     # still be drained before the conn is closed
                     self._retired_conns.append(worker.conn)
+                if worker.clock is not None:
+                    # likewise the clock pipe: a buffered ping (or the
+                    # death EOF) must be consumed, never left to leak
+                    self._retired_clocks.append(worker.clock)
                 # abandon the dead worker's task queue WITHOUT joining
                 # its feeder thread: with >1 pipe-buffer of pickled
                 # tasks queued to a worker that will never read them,
@@ -606,17 +641,40 @@ class DecodePool:
             with self._lock:
                 conn_map = {w.conn: w for w in self._workers
                             if w.conn is not None}
+                clock_map = {w.clock: w for w in self._workers
+                             if w.clock is not None}
                 retired = list(self._retired_conns)
-                done = self._closed and not conn_map and not retired
+                retired_clocks = list(self._retired_clocks)
+                done = (self._closed and not conn_map and not clock_map
+                        and not retired and not retired_clocks)
             if done:
                 return
-            for ready in _mpc.wait(list(conn_map) + retired
+            for ready in _mpc.wait(list(conn_map) + list(clock_map)
+                                   + retired + retired_clocks
                                    + [self._wake_r]):
                 if ready is self._wake_r:
                     try:
                         self._wake_r.recv_bytes()
                     except (EOFError, OSError):  # pragma: no cover
                         pass
+                    continue
+                if ready in clock_map or ready in retired_clocks:
+                    # one-shot clock handshake: answer the worker's ping
+                    # with THIS process's perf_counter_ns, then retire
+                    # the pipe (EOF here means the worker died first; a
+                    # send to a reaped worker's pipe fails harmlessly)
+                    try:
+                        ready.recv()
+                        ready.send(time.perf_counter_ns())
+                    except (EOFError, OSError):
+                        pass
+                    ready.close()
+                    with self._lock:
+                        worker = clock_map.get(ready)
+                        if worker is not None and worker.clock is ready:
+                            worker.clock = None
+                        if ready in self._retired_clocks:
+                            self._retired_clocks.remove(ready)
                     continue
                 try:
                     task_id, meta = ready.recv()
@@ -654,12 +712,24 @@ class DecodePool:
             chunk.result = arrays
         chunk.event.set()
         self._sem.release()
-        if telemetry.active() is not None:
+        tel = telemetry.active()
+        if tel is not None:
             telemetry.gauge_set(telemetry.M_DECODE_POOL_DEPTH, depth)
             telemetry.gauge_set(telemetry.M_DECODE_POOL_BUSY,
                                 min(depth, self.workers))
+            rec = meta.get("span")
+            if rec is not None and chunk.ctx is not None:
+                # adopt the worker-measured chunk span under the context
+                # captured at submit time — the worker has no tracer, so
+                # the span id is allocated here
+                tel.tracer.record_remote(
+                    rec["name"], chunk.ctx, rec["start_ns"],
+                    rec["end_ns"], pid=rec["pid"],
+                    process=f"decode-{rec['pid']}",
+                    **rec.get("attributes", {}))
             for dt in meta.get("decode_s", ()):
-                telemetry.observe(telemetry.M_DECODE_POOL_DECODE_S, dt)
+                telemetry.observe(telemetry.M_DECODE_POOL_DECODE_S, dt,
+                                  exemplar=chunk.ctx)
 
     # -- lifecycle -----------------------------------------------------------
 

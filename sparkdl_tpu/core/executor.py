@@ -1148,7 +1148,11 @@ class DeviceExecutor:
         t0 = time.monotonic()
         now = t0
         for r in batch:
-            telemetry.observe(telemetry.M_QUEUE_WAIT_S, now - r.t_enqueue)
+            # the request's submit-time span context rides as the tail
+            # exemplar: a breached queue-wait p99 names the exact trace
+            # that waited, not the coalescer thread's ambient context
+            telemetry.observe(telemetry.M_QUEUE_WAIT_S, now - r.t_enqueue,
+                              exemplar=r.ctx)
         groups: Dict[Tuple, List[_Request]] = {}
         for r in batch:
             groups.setdefault(batching.element_signature(r.tree),
@@ -1163,7 +1167,8 @@ class DeviceExecutor:
                 self._hand_back(group[0])
             else:
                 self._run_coalesced(state, group, rows)
-        telemetry.observe(telemetry.M_LAUNCH_S, time.monotonic() - t0)
+        telemetry.observe(telemetry.M_LAUNCH_S, time.monotonic() - t0,
+                          exemplar=batch[0].ctx if batch else None)
 
     @staticmethod
     def _hand_back(r: _Request) -> None:

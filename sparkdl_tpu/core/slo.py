@@ -216,17 +216,25 @@ class SLOWatchdog:
             state = self._states[rule.name]
             observed = rule.observe(windowed)
             state.last_observed = observed
+            # the offending traces behind a histogram verdict: the
+            # in-window tail exemplars (present only on scopes armed
+            # with Telemetry(exemplar_k=...)) — a breach names the
+            # concrete trace/span ids to chase, not just a number
+            hist = windowed["histograms"].get(rule.metric)
+            exemplars = (hist or {}).get("exemplars")
             if rule.breaching(observed):
                 if state.breach_since is None:
                     state.breach_since = now
                 if (not state.active
                         and now - state.breach_since >= rule.for_s):
                     state.active = True
+                    extra = ({"exemplars": exemplars} if exemplars
+                             else {})
                     health.record(health.SLO_BREACH, rule=rule.name,
                                   metric=rule.metric, stat=rule.stat,
                                   observed=observed,
                                   threshold=rule.threshold,
-                                  window_s=rule.window_s)
+                                  window_s=rule.window_s, **extra)
                     logger.warning(
                         "SLO breach %r: %s(%s over %gs) = %.6g %s %.6g "
                         "(held %.3gs)", rule.name, rule.stat, rule.metric,
@@ -250,6 +258,8 @@ class SLOWatchdog:
             out[rule.name] = {"observed": observed,
                               "threshold": rule.threshold,
                               "breached": state.active}
+            if state.active and exemplars:
+                out[rule.name]["exemplars"] = exemplars
         return out
 
     def state(self) -> Dict[str, Dict[str, Any]]:

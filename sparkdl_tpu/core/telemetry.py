@@ -110,13 +110,23 @@ SPAN_MODEL_LOAD = "sparkdl.model_load"        # serving cold start: loader
 SPAN_CLUSTER_DISPATCH = "sparkdl.cluster_dispatch"  # one partition's
                                               # round trip to a cluster
                                               # worker (cluster/router.py)
+SPAN_CLUSTER_TASK = "sparkdl.cluster_task"    # worker-side execution of
+                                              # one dispatched partition
+                                              # (cluster/worker.py)
+SPAN_DECODE_CHUNK = "sparkdl.decode_chunk"    # one chunk decoded inside
+                                              # a pool worker process
+                                              # (core/decode_pool.py)
+SPAN_SERVING_SHADOW = "sparkdl.serving_shadow"  # shadow-lane replay of
+                                              # one serving request
+                                              # (serving/server.py)
 
 CANONICAL_SPAN_NAMES = frozenset({
     SPAN_RUN, SPAN_RUNNER_ATTEMPT, SPAN_FIT, SPAN_EPOCH,
     SPAN_CHECKPOINT_SAVE, SPAN_ESTIMATOR_FIT, SPAN_COLLECT,
     SPAN_MATERIALIZE, SPAN_TASK, SPAN_TASK_ATTEMPT,
     SPAN_COMPILE, SPAN_COALESCED_LAUNCH, SPAN_DECODE_POOL,
-    SPAN_MODEL_LOAD, SPAN_CLUSTER_DISPATCH,
+    SPAN_MODEL_LOAD, SPAN_CLUSTER_DISPATCH, SPAN_CLUSTER_TASK,
+    SPAN_DECODE_CHUNK, SPAN_SERVING_SHADOW,
     # phase names (core/profiling.py constants + literal call sites)
     "sparkdl.decode", "sparkdl.stage", "sparkdl.stage_batch",
     "sparkdl.host_stage", "sparkdl.host_resize", "sparkdl.host_wait",
@@ -372,9 +382,15 @@ class Tracer:
         self.trace_id = trace_id
         self.max_spans = max_spans
         self.dropped = 0
+        self.remote_adopted = 0
+        self.remote_rejected = 0
         self._lock = threading.Lock()
         self._spans: "deque[Dict[str, Any]]" = deque(maxlen=max_spans)
-        self._ids = itertools.count(1)
+        # span ids are pid-salted: a cluster/decode worker's spans merge
+        # into the coordinator's ring, so ids allocated independently in
+        # each process must never collide (Linux pids fit in 22 bits;
+        # 40 low bits leave ~10^12 spans per process)
+        self._ids = itertools.count((os.getpid() << 40) | 1)
         self._t0_ns = time.perf_counter_ns()
 
     # -- producing -----------------------------------------------------------
@@ -447,21 +463,131 @@ class Tracer:
             "trace_id": self.trace_id,
             "spans_recorded": len(spans),
             "spans_dropped": self.dropped,
+            "remote_adopted": self.remote_adopted,
+            "remote_rejected": self.remote_rejected,
             "threads": sorted(t[1] for t in threads),
             "by_name": {k: by_name[k] for k in sorted(by_name)},
         }
+
+    # -- cross-process merge (docs/OBSERVABILITY.md "Distributed
+    # tracing"): a worker ships its ring rebased onto the parent's
+    # clock; the parent adopts it into ONE merged trace -------------------
+
+    def export_ring(self, *, clock_offset_ns: int = 0,
+                    process: Optional[str] = None,
+                    parent_remap: Optional[Dict[int, int]] = None,
+                    limit: int = 4096) -> Dict[str, Any]:
+        """The shippable view of this ring: every span rebased to the
+        PARENT's monotonic clock (``abs_ns = rel + t0 + offset``, offset
+        from the worker handshake) and stamped with this process's pid
+        and ``process`` track label. ``parent_remap`` rewrites parent
+        ids — the worker's still-open ``sparkdl.run`` root never ships,
+        so spans under it re-parent onto the coordinator's root instead
+        of dangling. Keeps the most recent ``limit`` spans; truncation
+        adds to the shipped ``dropped`` count (never silent)."""
+        spans = self.spans()
+        shipped_dropped = self.dropped
+        if len(spans) > limit:
+            shipped_dropped += len(spans) - limit
+            spans = spans[-limit:]
+        pid = os.getpid()
+        remap = parent_remap or {}
+        out = []
+        for s in spans:
+            rec = dict(s)
+            rec["start_ns"] = s["start_ns"] + self._t0_ns + clock_offset_ns
+            rec["end_ns"] = s["end_ns"] + self._t0_ns + clock_offset_ns
+            rec["pid"] = pid
+            if process is not None:
+                rec["process"] = process
+            parent = rec.get("parent_id")
+            if parent in remap:
+                rec["parent_id"] = remap[parent]
+            out.append(rec)
+        return {"spans": out, "dropped": shipped_dropped,
+                "clock_offset_ns": clock_offset_ns}
+
+    def adopt_remote_spans(self, records: Sequence[Dict[str, Any]]
+                           ) -> Tuple[int, int]:
+        """Merge spans shipped by :meth:`export_ring` in another process
+        into this ring: absolute parent-clock timestamps rebase onto
+        this tracer's epoch so local and remote spans share one
+        timeline. A record whose name is not canonical is REJECTED and
+        counted (a worker must not invent an unmergeable name — the
+        runtime half of the span-names lint); never raises. Returns
+        ``(adopted, rejected)``."""
+        adopted = rejected = 0
+        for s in records:
+            if s.get("name") not in CANONICAL_SPAN_NAMES:
+                rejected += 1
+                continue
+            rec = dict(s)
+            rec["start_ns"] = s["start_ns"] - self._t0_ns
+            rec["end_ns"] = s["end_ns"] - self._t0_ns
+            with self._lock:
+                if len(self._spans) == self.max_spans:
+                    self.dropped += 1
+                self._spans.append(rec)
+            adopted += 1
+        with self._lock:
+            self.remote_adopted += adopted
+            self.remote_rejected += rejected
+        return adopted, rejected
+
+    def record_remote(self, name: str, parent: Optional[SpanContext],
+                      start_abs_ns: int, end_abs_ns: int, *, pid: int,
+                      process: Optional[str] = None,
+                      **attributes: Any) -> bool:
+        """Adopt ONE remote span measured in another process from a wire
+        record (see :func:`remote_span`): the span id is allocated here
+        (the remote process — e.g. a decode-pool worker with no tracer —
+        never allocated one), timestamps arrive on this process's clock
+        base already. Non-canonical names are rejected and counted, not
+        raised. Returns True when recorded."""
+        if name not in CANONICAL_SPAN_NAMES:
+            with self._lock:
+                self.remote_rejected += 1
+            return False
+        rec: Dict[str, Any] = {
+            "name": name,
+            "trace_id": parent.trace_id if parent else self.trace_id,
+            "span_id": next(self._ids),
+            "parent_id": parent.span_id if parent else None,
+            "thread_id": 0,
+            "thread_name": process or f"pid-{pid}",
+            "start_ns": start_abs_ns - self._t0_ns,
+            "end_ns": end_abs_ns - self._t0_ns,
+            "pid": pid,
+        }
+        if process is not None:
+            rec["process"] = process
+        if attributes:
+            rec["attributes"] = attributes
+        with self._lock:
+            if len(self._spans) == self.max_spans:
+                self.dropped += 1
+            self._spans.append(rec)
+            self.remote_adopted += 1
+        return True
 
     def chrome_trace(self) -> Dict[str, Any]:
         """Chrome-trace (Trace Event Format) document: complete ("X")
         events in microseconds on one track per thread, loadable by
         ``chrome://tracing`` and Perfetto. Timestamps are monotonic
         (``perf_counter_ns`` rebased to the tracer epoch), so parent
-        spans always enclose their children."""
+        spans always enclose their children. Adopted remote spans keep
+        their origin pid, giving a merged cluster trace one labeled
+        process group per worker beside the coordinator's."""
         events: List[Dict[str, Any]] = []
-        pid = os.getpid()
-        seen_threads: Dict[int, str] = {}
+        own_pid = os.getpid()
+        seen_threads: Dict[Tuple[int, int], str] = {}
+        seen_procs: Dict[int, Optional[str]] = {}
         for s in self.spans():
-            seen_threads.setdefault(s["thread_id"], s["thread_name"])
+            pid = s.get("pid", own_pid)
+            seen_threads.setdefault((pid, s["thread_id"]),
+                                    s["thread_name"])
+            if s.get("process") is not None or pid not in seen_procs:
+                seen_procs[pid] = s.get("process") or seen_procs.get(pid)
             event = {
                 "name": s["name"], "cat": "sparkdl", "ph": "X",
                 "ts": s["start_ns"] / 1e3,
@@ -473,9 +599,18 @@ class Tracer:
                          **s.get("attributes", {})},
             }
             events.append(event)
-        for tid, tname in seen_threads.items():
+        for (pid, tid), tname in seen_threads.items():
             events.append({"name": "thread_name", "ph": "M", "pid": pid,
                            "tid": tid, "args": {"name": tname}})
+        # pid-labeled process groups only once remote spans merged in —
+        # a single-process trace keeps its pre-merge shape exactly
+        if len(seen_procs) > 1 or any(seen_procs.values()):
+            for pid, label in seen_procs.items():
+                name = label or ("coordinator" if pid == own_pid
+                                 else f"pid-{pid}")
+                events.append({"name": "process_name", "ph": "M",
+                               "pid": pid, "tid": 0,
+                               "args": {"name": name}})
         return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
@@ -645,11 +780,13 @@ class Histogram:
     """
 
     __slots__ = ("name", "_lock", "bounds", "_counts", "count", "sum",
-                 "min", "max", "_w_span", "_w_epochs", "_w_slots")
+                 "min", "max", "_w_span", "_w_epochs", "_w_slots",
+                 "_ex_k", "_w_ex")
 
     def __init__(self, name: str,
                  bounds: Sequence[float] = DEFAULT_TIME_BOUNDS,
-                 window: Optional[Tuple[float, int]] = None) -> None:
+                 window: Optional[Tuple[float, int]] = None,
+                 exemplar_k: int = 0) -> None:
         self.name = name
         self._lock = threading.Lock()
         self.bounds = tuple(float(b) for b in bounds)
@@ -659,6 +796,10 @@ class Histogram:
         self.min: Optional[float] = None
         self.max: Optional[float] = None
         self._w_span: Optional[float] = None
+        # opt-in tail-exemplar reservoir: the top-k observations per
+        # window slot, each carrying the span context that produced it —
+        # a breached p99 points at concrete traces, not just a number
+        self._ex_k = int(exemplar_k) if window is not None else 0
         if window is not None:
             span_s, slots = window
             self._w_span = float(span_s)
@@ -668,8 +809,14 @@ class Histogram:
             self._w_slots: List[List[Any]] = [
                 [[0] * (len(self.bounds) + 1), 0, 0.0, None, None]
                 for _ in range(slots)]
+            if self._ex_k:
+                # per-slot exemplar list, ascending by value (min first
+                # for O(1) eviction checks at tiny fixed k)
+                self._w_ex: List[List[Tuple[float, str, int]]] = [
+                    [] for _ in range(slots)]
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float,
+                exemplar: Optional[SpanContext] = None) -> None:
         value = float(value)
         idx = bisect.bisect_left(self.bounds, value)
         with self._lock:
@@ -689,6 +836,8 @@ class Histogram:
                     slot[0] = [0] * (len(self.bounds) + 1)
                     slot[1], slot[2] = 0, 0.0
                     slot[3] = slot[4] = None
+                    if self._ex_k:
+                        self._w_ex[i] = []
                 slot[0][idx] += 1
                 slot[1] += 1
                 slot[2] += value
@@ -696,6 +845,17 @@ class Histogram:
                     slot[3] = value
                 if slot[4] is None or value > slot[4]:
                     slot[4] = value
+                if self._ex_k and exemplar is not None:
+                    ex = self._w_ex[i]
+                    if len(ex) < self._ex_k:
+                        bisect.insort(
+                            ex, (value, exemplar.trace_id,
+                                 exemplar.span_id))
+                    elif value > ex[0][0]:  # beats the smallest kept
+                        ex.pop(0)
+                        bisect.insort(
+                            ex, (value, exemplar.trace_id,
+                                 exemplar.span_id))
 
     def percentile(self, q: float) -> Optional[float]:
         """Estimated q-quantile (q in [0, 1]) from the bucket counts
@@ -736,16 +896,22 @@ class Histogram:
         """Merged ``{count, sum, rate_per_s, min, max, p50, p95, p99}``
         over the trailing ``window_s`` (resolution = one ring slot).
         Percentiles and min/max are ``None`` on an empty window; all
-        zeros/None without a ring."""
+        zeros/None without a ring. With an armed exemplar reservoir the
+        snapshot additionally carries ``exemplars``: the top-k in-window
+        observations (descending), each
+        ``{value, trace_id, span_id}`` — the key is absent entirely when
+        exemplars are off, keeping the unarmed shape unchanged."""
         counts = [0] * (len(self.bounds) + 1)
         count, total = 0, 0.0
         vmin: Optional[float] = None
         vmax: Optional[float] = None
+        exemplars: List[Tuple[float, str, int]] = []
         if self._w_span is not None:
             with self._lock:
                 floor_epoch = _window_floor(self._w_span,
                                             len(self._w_slots), window_s)
-                for e, slot in zip(self._w_epochs, self._w_slots):
+                for i, (e, slot) in enumerate(zip(self._w_epochs,
+                                                  self._w_slots)):
                     if e < floor_epoch or not slot[1]:
                         continue
                     for j, c in enumerate(slot[0]):
@@ -754,7 +920,9 @@ class Histogram:
                     total += slot[2]
                     vmin = slot[3] if vmin is None else min(vmin, slot[3])
                     vmax = slot[4] if vmax is None else max(vmax, slot[4])
-        return {
+                    if self._ex_k:
+                        exemplars.extend(self._w_ex[i])
+        out = {
             "count": count, "sum": round(total, 9),
             "rate_per_s": round(count / window_s, 9) if window_s else 0.0,
             "min": vmin, "max": vmax,
@@ -765,6 +933,12 @@ class Histogram:
             "p99": _estimate_percentile(0.99, counts, count, self.bounds,
                                         vmin, vmax),
         }
+        if self._ex_k:
+            exemplars.sort(reverse=True)
+            out["exemplars"] = [
+                {"value": v, "trace_id": t, "span_id": s}
+                for v, t, s in exemplars[:self._ex_k]]
+        return out
 
 
 def escape_label_value(value: Any) -> str:
@@ -788,15 +962,24 @@ class MetricsRegistry:
     queryable trailing window, bucketed into ``window_buckets`` ring
     slots (the window resolution). ``window_s=None`` (the bare-registry
     default) creates ring-free instruments — the pre-windowing record
-    path, not even a clock read per record."""
+    path, not even a clock read per record.
+
+    ``exemplar_k`` (opt-in, default 0 = off) arms a per-slot tail
+    exemplar reservoir on every histogram created here: callers passing
+    a span context to :meth:`Histogram.observe` get their top-k
+    observations per window surfaced with ``{value, trace_id, span_id}``
+    in windowed snapshots."""
 
     def __init__(self, window_s: Optional[float] = None,
-                 window_buckets: int = 12) -> None:
+                 window_buckets: int = 12, exemplar_k: int = 0) -> None:
         self._lock = threading.Lock()
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
         self._histograms: Dict[str, Histogram] = {}
         self._window: Optional[Tuple[float, int]] = None
+        if exemplar_k < 0:
+            raise ValueError(f"exemplar_k must be >= 0, got {exemplar_k!r}")
+        self.exemplar_k = int(exemplar_k)
         if window_s is not None:
             if window_s <= 0 or window_buckets <= 0:
                 raise ValueError(
@@ -829,7 +1012,8 @@ class MetricsRegistry:
             inst = self._histograms.get(name)
             if inst is None:
                 inst = self._histograms[name] = Histogram(
-                    name, bounds, window=self._window)
+                    name, bounds, window=self._window,
+                    exemplar_k=self.exemplar_k)
             return inst
 
     def snapshot(self) -> Dict[str, Any]:
@@ -972,10 +1156,17 @@ class SnapshotExporter:
         self.prom_path: Optional[str] = None
         if out_dir:
             os.makedirs(out_dir, exist_ok=True)
+            # run_id alone is NOT unique across processes: cluster
+            # workers pin the coordinator's run_id, so a shared out_dir
+            # needs the scope's process suffix to avoid silently
+            # clobbering the coordinator's files. The coordinator
+            # (process_scope=None) keeps the bare historical names.
+            scope = getattr(tel, "process_scope", None)
+            suffix = f".{scope}" if scope else ""
             self.snapshot_path = os.path.join(
-                out_dir, f"sparkdl_snapshots_{tel.run_id}.jsonl")
+                out_dir, f"sparkdl_snapshots_{tel.run_id}{suffix}.jsonl")
             self.prom_path = os.path.join(
-                out_dir, f"sparkdl_metrics_{tel.run_id}.prom")
+                out_dir, f"sparkdl_metrics_{tel.run_id}{suffix}.prom")
         self._t0 = _monotonic()
         self._next_due = self._t0 + self.interval_s
         self._tick_lock = threading.Lock()  # thread tick vs close flush
@@ -1106,6 +1297,12 @@ class SnapshotExporter:
         if snap.get("slo") is not None:
             entry["slo_breached"] = sorted(
                 name for name, st in snap["slo"].items() if st["breached"])
+            exemplars = {
+                name: st["exemplars"]
+                for name, st in snap["slo"].items()
+                if st["breached"] and st.get("exemplars")}
+            if exemplars:
+                entry["slo_exemplars"] = exemplars
         if snap.get("final"):
             entry["final"] = True
         return entry
@@ -1170,7 +1367,9 @@ class Telemetry:
                  window_buckets: int = 12,
                  export_interval_s: Optional[float] = None,
                  slo_rules: Optional[Sequence[Any]] = None,
-                 run_id: Optional[str] = None) -> None:
+                 run_id: Optional[str] = None,
+                 exemplar_k: int = 0,
+                 process_scope: Optional[str] = None) -> None:
         self.name = name
         self.out_dir = (out_dir if out_dir is not None
                         else os.environ.get(TELEMETRY_DIR_ENV))
@@ -1180,9 +1379,15 @@ class Telemetry:
         # file before and after a crash. Default: fresh per-scope id.
         self.run_id = run_id or (
             f"{name}-{os.getpid():x}-{next(_run_counter):04x}")
+        # process_scope disambiguates output files when several
+        # processes share a run_id AND an out_dir (cluster workers pin
+        # the coordinator's run_id); None — the coordinator and the
+        # durable-resume path — keeps the bare file names.
+        self.process_scope = process_scope
         self.tracer = Tracer(trace_id=self.run_id, max_spans=max_spans)
         self.metrics = MetricsRegistry(window_s=window_s,
-                                       window_buckets=window_buckets)
+                                       window_buckets=window_buckets,
+                                       exemplar_k=exemplar_k)
         if export_interval_s is None:
             env = os.environ.get(EXPORT_INTERVAL_ENV)
             export_interval_s = float(env) if env else None
@@ -1297,8 +1502,9 @@ class Telemetry:
         """Write the run report + Chrome trace JSONs; returns the report
         path (also kept in :attr:`report_path` / :attr:`trace_path`)."""
         os.makedirs(out_dir, exist_ok=True)
+        suffix = f".{self.process_scope}" if self.process_scope else ""
         trace_path = os.path.join(
-            out_dir, f"sparkdl_trace_{self.run_id}.json")
+            out_dir, f"sparkdl_trace_{self.run_id}{suffix}.json")
         # tmp + os.replace (analyzer rule atomic-write): a crash while
         # exporting must not leave a torn report that a durable-resume
         # reader would trust
@@ -1309,7 +1515,7 @@ class Telemetry:
         report = self.report()
         report["chrome_trace"] = trace_path
         report_path = os.path.join(
-            out_dir, f"sparkdl_run_report_{self.run_id}.json")
+            out_dir, f"sparkdl_run_report_{self.run_id}{suffix}.json")
         tmp = f"{report_path}.tmp"
         with open(tmp, "w") as f:
             json.dump(report, f, indent=2, default=str)
@@ -1377,10 +1583,60 @@ def gauge_set(name: str, value: float) -> None:
 
 
 def observe(name: str, value: float,
-            bounds: Sequence[float] = DEFAULT_TIME_BOUNDS) -> None:
+            bounds: Sequence[float] = DEFAULT_TIME_BOUNDS,
+            exemplar: Optional[SpanContext] = None) -> None:
+    """Record one histogram observation, optionally tagged with the span
+    context that produced it (kept only by scopes armed with
+    ``exemplar_k``; inert — not even stored — otherwise)."""
     tel = _active
     if tel is not None:
-        tel.metrics.histogram(name, bounds).observe(value)
+        tel.metrics.histogram(name, bounds).observe(value, exemplar)
+
+
+def remote_span(name: str, start_abs_ns: int, end_abs_ns: int, *,
+                pid: Optional[int] = None,
+                **attributes: Any) -> Dict[str, Any]:
+    """Build the WIRE record for a span measured in a process with no
+    tracer of its own (a decode-pool worker): timestamps must already be
+    on the ADOPTING process's clock base (worker perf_counter_ns + the
+    handshake offset). The adopting side turns it into a real span via
+    :meth:`Tracer.record_remote`. The name must be canonical — this is
+    the process-boundary half of the span-names lint, enforced at
+    build time so a worker cannot ship an unmergeable name."""
+    if name not in CANONICAL_SPAN_NAMES:
+        raise ValueError(
+            f"remote span name {name!r} is not in CANONICAL_SPAN_NAMES; "
+            "span names crossing a process boundary must be canonical "
+            "(docs/OBSERVABILITY.md)")
+    rec: Dict[str, Any] = {
+        "name": name,
+        "start_ns": int(start_abs_ns),
+        "end_ns": int(end_abs_ns),
+        "pid": pid if pid is not None else os.getpid(),
+    }
+    if attributes:
+        rec["attributes"] = attributes
+    return rec
+
+
+def clock_handshake(conn: Any, timeout_s: float = 5.0) -> int:
+    """Worker half of the cross-process clock exchange (NTP-style, one
+    round trip over a dedicated pipe): send a ping, read the parent's
+    ``perf_counter_ns`` reply, and return the offset that maps THIS
+    process's ``perf_counter_ns`` onto the parent's
+    (``parent_ns ≈ local_ns + offset``), assuming symmetric transit.
+    Falls back to 0 (clocks assumed aligned — on Linux both processes
+    read the same CLOCK_MONOTONIC) if the parent never answers."""
+    try:
+        t0 = time.perf_counter_ns()
+        conn.send(("clock", t0))
+        if not conn.poll(timeout_s):
+            return 0
+        t_parent = conn.recv()
+        t1 = time.perf_counter_ns()
+        return int(t_parent) - (t0 + t1) // 2
+    except (EOFError, OSError):
+        return 0
 
 
 # ---------------------------------------------------------------------------

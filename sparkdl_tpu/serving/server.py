@@ -136,8 +136,11 @@ class ModelServer:
         shadowed = False
         if shadow is not None:
             active_s = time.monotonic() - t0
+            # the request's span context rides into the shadow lane
+            # explicitly: shadow work stays attributable to THIS request
+            # even if the lane ever moves off the caller thread
             self._run_shadow(model, active, shadow, batch, out, active_s,
-                             window_ms)
+                             window_ms, ctx=telemetry.current_context())
             shadowed = True
         latency_s = time.monotonic() - t0
         if telemetry.active() is not None:
@@ -183,19 +186,25 @@ class ModelServer:
 
     def _run_shadow(self, name: str, active: Any, shadow: Any,
                     batch: Any, active_out: Any, active_s: float,
-                    window_ms: Optional[float]) -> None:
+                    window_ms: Optional[float],
+                    ctx: Optional[telemetry.SpanContext] = None) -> None:
         """Mirror ONE request to the shadow version: run it on the BULK
         lane (a candidate must never crowd live traffic), compare
         outputs element-wise, record divergence + both latencies. A
         shadow failure records ``serving_shadow_error`` and is
         swallowed — the client already has its answer from the active
-        version."""
+        version. The shadow leg runs under its own
+        ``sparkdl.serving_shadow`` span parented on the request context
+        ``ctx``."""
         t0 = time.monotonic()
         try:
-            shadow_out = executor.execute(
-                shadow.model(), batch, batch_size=shadow.batch_size,
-                priority=executor.PRIORITY_BULK,
-                coalesce_window_ms=window_ms)
+            with telemetry.span(telemetry.SPAN_SERVING_SHADOW,
+                                parent=ctx, model=name,
+                                shadow_version=shadow.version):
+                shadow_out = executor.execute(
+                    shadow.model(), batch, batch_size=shadow.batch_size,
+                    priority=executor.PRIORITY_BULK,
+                    coalesce_window_ms=window_ms)
         except Exception as e:  # noqa: BLE001 - recorded, never re-raised
             health.record(health.SERVING_SHADOW_ERROR, model=name,
                           active_version=active.version,

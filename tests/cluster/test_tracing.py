@@ -1,0 +1,240 @@
+"""Cross-process distributed tracing (ISSUE 15 tentpole): a cluster
+featurize (workers=2) with the decode pool armed in the coordinator
+produces ONE merged Chrome trace — worker task spans and in-worker
+decode-chunk spans parent transitively under the coordinator's
+``sparkdl.run`` — proven by walking parent links, not by name matching
+alone. Plus: per-worker span-ring accounting in the merged report, the
+SIGKILL chaos leg with tracing armed (exactly one span-ring-lost entry,
+outputs still bit-identical), and the off-path guarantee (no telemetry
+scope -> no rings shipped, no trace section, nothing new in reports).
+"""
+
+import io
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+from PIL import Image
+
+from sparkdl_tpu.cluster import router as cluster_router
+from sparkdl_tpu.core import decode_pool, health, telemetry
+from sparkdl_tpu.core.decode_pool import DecodePool
+from sparkdl_tpu.core.health import HealthMonitor
+from sparkdl_tpu.core.resilience import Fault, FaultInjector
+from sparkdl_tpu.core.telemetry import Telemetry
+from sparkdl_tpu.engine import DataFrame, EngineConfig
+
+# clock-handshake slack when comparing adopted remote timestamps with
+# coordinator-side span bounds (the offset estimate is RTT/2-accurate;
+# 50 ms is orders of magnitude above a local pipe round-trip)
+_CLOCK_SLACK_NS = 50_000_000
+
+
+@pytest.fixture(autouse=True)
+def _restore_engine_config():
+    saved = EngineConfig.snapshot()
+    yield
+    EngineConfig.restore(saved)
+    cluster_router.shutdown()
+    decode_pool.shutdown()
+
+
+def _frame(n=24, parts=4):
+    return DataFrame.fromRows([{"x": i} for i in range(n)],
+                              numPartitions=parts)
+
+
+def _featurized(n=24, parts=4):
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(5)
+    w = jnp.asarray(rng.normal(size=(1, 3)).astype(np.float32))
+
+    def op(batch):
+        health.record("cluster_probe")
+        x = np.asarray(batch.column("x"), dtype=np.float32).reshape(-1, 1)
+        y = np.asarray(jnp.tanh(x @ w), dtype=np.float32)
+        return pa.array(y.sum(axis=1).astype("float64"))
+
+    return _frame(n, parts).withColumnBatch("y", op,
+                                            outputType=pa.float64())
+
+
+def _blobs(n=8):
+    rng = np.random.default_rng(0)
+    out = []
+    for i in range(n):
+        buf = io.BytesIO()
+        Image.fromarray(rng.integers(0, 255, (8 + 8 * (i % 3), 16, 3),
+                                     dtype=np.uint8)
+                        ).save(buf, format="JPEG", quality=90)
+        out.append(buf.getvalue())
+    return out
+
+
+def _walk(by_id, span):
+    """Follow parent links up to the root, asserting every link resolves
+    inside the merged ring (a dangling parent = a span that shipped but
+    whose parent didn't) and that there are no cycles. Returns the chain
+    root-last."""
+    chain = [span]
+    seen = {span["span_id"]}
+    cur = span
+    while cur["parent_id"] is not None:
+        pid = cur["parent_id"]
+        assert pid in by_id, (
+            f"dangling parent link {pid:#x} from {cur['name']!r}")
+        cur = by_id[pid]
+        assert cur["span_id"] not in seen, "parent-link cycle"
+        seen.add(cur["span_id"])
+        chain.append(cur)
+    return chain
+
+
+# -- the acceptance walk -----------------------------------------------------
+
+def test_cluster_trace_merges_under_one_run_root():
+    """Workers=2 cluster featurize + a coordinator-side pooled decode,
+    one telemetry scope: every remote span (cluster task, decode chunk)
+    walks parent links to the SAME ``sparkdl.run`` root."""
+    EngineConfig.cluster_workers = 2
+    with Telemetry(name="trace-merge", out_dir="") as tel:
+        try:
+            _featurized().collect()
+            with DecodePool(workers=2) as pool:
+                got = pool.decode(_blobs(8), target_size=(8, 8),
+                                  channels=3)
+            assert all(a is not None for a in got)
+        finally:
+            # inside the scope: close() is the adoption moment and the
+            # merged RunReport needs the active scope
+            cluster_router.shutdown()
+
+    # assertions AFTER scope exit: the run root records at __exit__
+    spans = tel.tracer.spans()
+    by_id = {s["span_id"]: s for s in spans}
+    own_pid = os.getpid()
+    assert {s["trace_id"] for s in spans} == {tel.run_id}
+
+    tasks = [s for s in spans
+             if s["name"] == telemetry.SPAN_CLUSTER_TASK]
+    assert len(tasks) == 4  # one adopted worker span per partition
+    for s in tasks:
+        assert s["pid"] != own_pid  # measured in a worker process
+        chain = _walk(by_id, s)
+        names = [c["name"] for c in chain]
+        assert names[1] == telemetry.SPAN_CLUSTER_DISPATCH
+        assert names[-1] == telemetry.SPAN_RUN
+        assert chain[-1]["parent_id"] is None
+        # the handshake made the timelines comparable: the coordinator's
+        # dispatch round-trip encloses the worker-side task span
+        disp = chain[1]
+        assert "pid" not in disp  # coordinator-local span
+        assert s["start_ns"] >= disp["start_ns"] - _CLOCK_SLACK_NS
+        assert s["end_ns"] <= disp["end_ns"] + _CLOCK_SLACK_NS
+
+    chunks = [s for s in spans
+              if s["name"] == telemetry.SPAN_DECODE_CHUNK]
+    assert chunks  # the pool fanned out at least one chunk
+    for s in chunks:
+        assert s["pid"] != own_pid
+        chain = _walk(by_id, s)
+        names = [c["name"] for c in chain]
+        assert names[1] == telemetry.SPAN_DECODE_POOL
+        assert names[-1] == telemetry.SPAN_RUN
+
+    summ = tel.tracer.summary()
+    assert summ["remote_adopted"] >= len(tasks) + len(chunks)
+    assert summ["remote_rejected"] == 0
+
+    # per-worker span-ring accounting in the merged cluster section
+    rep = cluster_router.last_cluster_report()
+    trace = rep["trace"]
+    assert trace["span_rings_lost"] == []
+    assert set(trace["workers"]) == set(rep["workers"])
+    for acct in trace["workers"].values():
+        assert acct["shipped"] >= 1
+        assert acct["dropped"] == 0
+    run_report = cluster_router.last_run_report()
+    assert run_report is not None
+    assert run_report["cluster"]["trace"] == trace
+
+    # ONE Chrome document with labeled process groups per remote process
+    doc = tel.tracer.chrome_trace()
+    labels = {e["args"]["name"] for e in doc["traceEvents"]
+              if e["ph"] == "M" and e["name"] == "process_name"}
+    assert "coordinator" in labels
+    assert any(l.startswith("sparkdl-cluster-") for l in labels)
+    assert any(l.startswith("decode-") for l in labels)
+    pids = {e["pid"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert len(pids) >= 3  # coordinator + >=1 cluster + >=1 decode pid
+
+
+# -- the off path ------------------------------------------------------------
+
+def test_no_scope_ships_no_rings_and_reports_stay_shaped():
+    """Without a telemetry scope nothing about tracing leaks into the
+    cluster protocol or the merged report: no span_ring in snapshots, no
+    ``trace`` section, no merged RunReport at all."""
+    EngineConfig.cluster_workers = 2
+    try:
+        got = _featurized().collect()
+    finally:
+        cluster_router.shutdown()
+    assert len(got) == 24
+
+    rep = cluster_router.last_cluster_report()
+    assert rep is not None and rep["worker_count"] == 2
+    assert "trace" not in rep
+    for snap in rep["workers"].values():
+        assert "span_ring" not in snap
+    assert cluster_router.last_run_report() is None
+
+
+# -- chaos: SIGKILL with tracing armed ---------------------------------------
+
+def test_worker_kill_keeps_merged_trace_and_accounts_the_lost_ring():
+    """One worker SIGKILLed mid-stream with tracing armed: outputs stay
+    bit-identical, the merged trace still builds with correct parenting
+    from the survivor, and the dead worker shows up as EXACTLY ONE
+    span-ring-lost accounting entry (its spans died with it — the report
+    says so instead of pretending full coverage)."""
+    want = _featurized(36, 6).collect()
+
+    EngineConfig.cluster_workers = 2
+    inj = FaultInjector.seeded(0, cluster_worker_kill=Fault(times=1,
+                                                            after=2))
+    with HealthMonitor("trace-chaos") as mon, \
+            Telemetry(name="trace-chaos", out_dir="") as tel:
+        try:
+            with inj:
+                got = _featurized(36, 6).collect()
+        finally:
+            cluster_router.shutdown()
+
+    assert inj.fired == {"cluster_worker_kill": 1}
+    assert got == want  # bit-identical THROUGH the loss, tracing armed
+    assert mon.count(health.CLUSTER_WORKER_LOST) == 1
+
+    rep = cluster_router.last_cluster_report()
+    assert rep["worker_count"] == 1  # snapshots, not spawns
+    trace = rep["trace"]
+    assert len(trace["span_rings_lost"]) == 1
+    (survivor,) = trace["workers"]
+    assert survivor not in trace["span_rings_lost"]
+    assert trace["workers"][survivor]["shipped"] >= 1
+
+    # the survivor's spans still parent correctly under the run root
+    spans = tel.tracer.spans()
+    by_id = {s["span_id"]: s for s in spans}
+    tasks = [s for s in spans
+             if s["name"] == telemetry.SPAN_CLUSTER_TASK]
+    assert tasks  # at least the re-dispatched partitions ran somewhere
+    for s in tasks:
+        chain = _walk(by_id, s)
+        assert chain[-1]["name"] == telemetry.SPAN_RUN
+    # and the merged Chrome doc still builds as one multi-process trace
+    doc = tel.tracer.chrome_trace()
+    pids = {e["pid"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert len(pids) >= 2

@@ -353,3 +353,40 @@ def test_pool_startup_runs_orphan_sweep():
         assert seg.name not in set(os.listdir(decode_pool._SHM_DIR))
     finally:
         pool.close()
+
+
+def test_decode_chunk_spans_adopt_under_the_pool_span():
+    """ISSUE 15: with a telemetry scope active, every chunk a worker
+    decodes comes back with a ``sparkdl.decode_chunk`` span measured
+    IN the worker (origin pid preserved) and adopted under the
+    coordinator's ``sparkdl.decode_pool`` span."""
+    blobs = _blobs(12)
+    with Telemetry("decode-trace") as tel, DecodePool(workers=2) as pool:
+        got = pool.decode(blobs, target_size=(8, 8), channels=3)
+    assert len(got) == len(blobs)
+    (pool_span,) = tel.tracer.spans(telemetry.SPAN_DECODE_POOL)
+    chunks = tel.tracer.spans(telemetry.SPAN_DECODE_CHUNK)
+    assert chunks  # the fan-out produced at least one chunk
+    worker_pids = set()
+    for s in chunks:
+        assert s["parent_id"] == pool_span["span_id"]
+        assert s["trace_id"] == tel.run_id
+        assert s["pid"] != os.getpid()    # measured in the worker
+        assert s["process"] == f"decode-{s['pid']}"
+        assert s["end_ns"] >= s["start_ns"]
+        worker_pids.add(s["pid"])
+    assert sum(s["attributes"]["blobs"] for s in chunks) == len(blobs)
+    assert tel.tracer.summary()["remote_adopted"] == len(chunks)
+
+
+def test_decode_without_scope_ships_no_spans():
+    """Tracing off (no scope): the task tuple carries ctx=None, workers
+    build no wire records, and a LATER scope sees nothing adopted —
+    the off path stays observability-free end to end."""
+    blobs = _blobs(6)
+    with DecodePool(workers=1) as pool:
+        pool.decode(blobs, target_size=(8, 8), channels=3)
+        with Telemetry("later") as tel:
+            pass
+    assert tel.tracer.spans(telemetry.SPAN_DECODE_CHUNK) == []
+    assert tel.tracer.summary()["remote_adopted"] == 0

@@ -4,6 +4,7 @@ Telemetry teardown, and a crashed tick that records a health event
 instead of dying silently."""
 
 import json
+import os
 import threading
 import time
 
@@ -173,3 +174,32 @@ def test_exporter_without_out_dir_keeps_timeline_only(monkeypatch):
     report = tel.report()
     assert report["timeline"]["snapshots"] == 2  # tick + final flush
     assert report["timeline"]["snapshot_path"] is None
+
+
+def test_shared_run_id_scopes_write_disjoint_files(tmp_path):
+    """ISSUE 15 satellite: two scopes sharing run_id AND out_dir (a
+    cluster worker pins the coordinator's run id) must not clobber each
+    other's artifacts — the worker's process_scope suffixes every file
+    name while the coordinator keeps the bare historical names."""
+    out = str(tmp_path)
+    with Telemetry("coord", out_dir=out, run_id="shared",
+                   export_interval_s=30.0) as coord:
+        pass
+    with Telemetry("worker", out_dir=out, run_id="shared",
+                   export_interval_s=30.0, process_scope="w0") as worker:
+        pass
+    names = sorted(os.listdir(out))
+    for stem in ("sparkdl_snapshots_shared{}.jsonl",
+                 "sparkdl_metrics_shared{}.prom",
+                 "sparkdl_trace_shared{}.json",
+                 "sparkdl_run_report_shared{}.json"):
+        assert stem.format("") in names          # coordinator: bare
+        assert stem.format(".w0") in names       # worker: suffixed
+    # each artifact is really its own scope's, not a lucky overwrite
+    assert json.load(open(coord.report_path))["run"] == "coord"
+    assert json.load(open(worker.report_path))["run"] == "worker"
+    assert coord.report_path != worker.report_path
+    assert coord.exporter.snapshot_path != worker.exporter.snapshot_path
+    with open(worker.exporter.snapshot_path) as f:
+        (line,) = [json.loads(l) for l in f]     # the final flush
+    assert line["run_id"] == "shared" and line["final"] is True

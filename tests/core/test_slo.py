@@ -269,3 +269,73 @@ def test_scope_wires_watchdog_into_exporter_snapshots(tmp_path):
         assert set(line["slo"]) == {r.name for r in rules}
         for verdict in line["slo"].values():
             assert verdict["breached"] is False  # quiet run: no paging
+
+
+# -- tail exemplars (ISSUE 15) -----------------------------------------------
+
+def test_breach_carries_exemplars_into_event_exporter_and_trace(tmp_path):
+    """Acceptance: an induced queue-wait breach on an exemplar-armed
+    scope attaches >=1 exemplar trace id to the slo_breach event, the
+    exporter's snapshot line mirrors it under ``slo_exemplars``, and the
+    span id resolves to a REAL span in the exported Chrome trace — a
+    page links straight to the offending trace."""
+    rules = [SLORule("qw", metric=telemetry.M_QUEUE_WAIT_S, window_s=5.0,
+                     threshold=0.1, stat="p99")]
+    with HealthMonitor("slo-ex") as mon, \
+            Telemetry("slo-ex", out_dir=str(tmp_path),
+                      export_interval_s=0.02, window_s=10.0,
+                      window_buckets=10, exemplar_k=3,
+                      slo_rules=rules) as tel:
+        with telemetry.span(telemetry.SPAN_TASK, partition=7) as sp:
+            ctx = sp.context
+            telemetry.observe(telemetry.M_QUEUE_WAIT_S, 5.0,
+                              exemplar=ctx)
+        deadline = time.monotonic() + 5.0
+        while (mon.count(health.SLO_BREACH) == 0
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert mon.count(health.SLO_BREACH) == 1
+    breach = mon.events(health.SLO_BREACH)[0]
+    assert breach["rule"] == "qw"
+    assert breach["exemplars"] == [
+        {"value": 5.0, "trace_id": tel.run_id, "span_id": ctx.span_id}]
+    # the live plane: the breaching snapshot line names the same trace
+    with open(tel.exporter.snapshot_path) as f:
+        lines = [json.loads(line) for line in f]
+    carrying = [l for l in lines
+                if (l["slo"]["qw"].get("exemplars")
+                    and l["slo"]["qw"]["breached"])]
+    assert carrying
+    assert carrying[0]["slo"]["qw"]["exemplars"][0]["span_id"] == \
+        ctx.span_id
+    # ...and the run report's compact timeline mirrors it
+    report = json.load(open(tel.report_path))
+    timeline = [e for e in report["timeline"]["entries"]
+                if e.get("slo_exemplars")]
+    assert timeline
+    assert timeline[0]["slo_breached"] == ["qw"]
+    assert timeline[0]["slo_exemplars"]["qw"][0]["span_id"] == \
+        ctx.span_id
+    # and the id is not a dangling pointer: it resolves to an exported
+    # span in the scope's own Chrome trace artifact
+    trace = json.load(open(tel.trace_path))
+    by_span_id = {e["args"]["span_id"]: e
+                  for e in trace["traceEvents"] if e["ph"] == "X"}
+    assert by_span_id[ctx.span_id]["name"] == telemetry.SPAN_TASK
+
+
+def test_unbreached_rules_ship_no_exemplars(clock):
+    """Exemplars ride ONLY breached verdicts: a healthy evaluation over
+    an armed scope keeps the verdict shape exemplar-free."""
+    rule = SLORule("qw", metric=telemetry.M_QUEUE_WAIT_S, window_s=2.0,
+                   threshold=10.0, stat="p99")
+    with HealthMonitor(), Telemetry("quiet", window_s=10.0,
+                                    window_buckets=10,
+                                    exemplar_k=2) as tel:
+        with telemetry.span(telemetry.SPAN_TASK) as sp:
+            telemetry.observe(telemetry.M_QUEUE_WAIT_S, 0.01,
+                              exemplar=sp.context)
+        wd = SLOWatchdog([rule])
+        out = wd.evaluate(tel.metrics)
+    assert out["qw"]["breached"] is False
+    assert "exemplars" not in out["qw"]

@@ -3,6 +3,7 @@ log-scale histograms, Chrome-trace export, run report, zero-cost no-op."""
 
 import json
 import logging
+import os
 import threading
 import time
 
@@ -712,3 +713,195 @@ def test_trainer_fit_emits_spans_and_step_metrics():
     # host step-interval histogram observed (never a device sync)
     snap = tel.metrics.snapshot()
     assert snap["histograms"][telemetry.M_STEP_TIME_S]["count"] == 3
+
+
+# -- cross-process tracing + tail exemplars (ISSUE 15) ------------------------
+
+def test_histogram_window_snapshot_at_ring_rotation_boundary(fake_clock):
+    """The exact slot-rotation edge: a slot at window-age stays included
+    (resolution = one slot span), one tick past it ages out, and a fresh
+    observation REUSES its ring index after clearing the old exemplars —
+    no ghosts from the previous revolution."""
+    reg = MetricsRegistry(window_s=10.0, window_buckets=10,
+                          exemplar_k=2)  # 1 s slots
+    h = reg.histogram("sparkdl.executor.queue_wait_s")
+    ctx_a = telemetry.SpanContext("t", 0xA)
+    h.observe(0.4, exemplar=ctx_a)        # lands in slot epoch 1000
+    fake_clock.advance(9.0)               # exact boundary: still inside
+    w = h.window_snapshot(10.0)
+    assert w["count"] == 1
+    assert w["exemplars"] == [
+        {"value": 0.4, "trace_id": "t", "span_id": 0xA}]
+    fake_clock.advance(1.0)               # one slot past: aged out
+    w = h.window_snapshot(10.0)
+    assert w["count"] == 0
+    assert w["exemplars"] == []           # armed: key present but empty
+    # same ring index, new epoch: rotation resets counts AND exemplars
+    ctx_b = telemetry.SpanContext("t", 0xB)
+    h.observe(0.2, exemplar=ctx_b)
+    w = h.window_snapshot(10.0)
+    assert w["count"] == 1
+    assert w["exemplars"] == [
+        {"value": 0.2, "trace_id": "t", "span_id": 0xB}]
+
+
+def test_exemplar_reservoir_keeps_topk_by_value(fake_clock):
+    """k=2 reservoir: the smallest kept exemplar is evicted by a larger
+    newcomer, a sub-minimum value is rejected, and the snapshot lists
+    survivors descending."""
+    reg = MetricsRegistry(window_s=10.0, window_buckets=10, exemplar_k=2)
+    h = reg.histogram("sparkdl.executor.queue_wait_s")
+    for value, span_id in ((1.0, 0xA), (3.0, 0xB), (2.0, 0xC)):
+        h.observe(value, exemplar=telemetry.SpanContext("t", span_id))
+    w = h.window_snapshot(10.0)
+    assert w["exemplars"] == [
+        {"value": 3.0, "trace_id": "t", "span_id": 0xB},
+        {"value": 2.0, "trace_id": "t", "span_id": 0xC}]  # 0xA evicted
+    h.observe(0.5, exemplar=telemetry.SpanContext("t", 0xD))
+    assert h.window_snapshot(10.0)["exemplars"] == [
+        {"value": 3.0, "trace_id": "t", "span_id": 0xB},
+        {"value": 2.0, "trace_id": "t", "span_id": 0xC}]  # 0xD rejected
+    # an exemplar-less observation still counts, just isn't kept
+    h.observe(9.0)
+    w = h.window_snapshot(10.0)
+    assert w["count"] == 5 and w["max"] == 9.0
+    assert w["exemplars"][0]["span_id"] == 0xB
+
+
+def test_exemplars_off_keeps_window_snapshot_shape(fake_clock):
+    """Unarmed (the default): passing an exemplar is inert and the
+    snapshot has NO ``exemplars`` key — the pre-ISSUE-15 shape exactly."""
+    reg = MetricsRegistry(window_s=10.0, window_buckets=10)
+    h = reg.histogram("sparkdl.executor.queue_wait_s")
+    h.observe(0.3, exemplar=telemetry.SpanContext("t", 1))
+    w = h.window_snapshot(10.0)
+    assert w["count"] == 1
+    assert "exemplars" not in w
+
+
+def test_export_ring_rebases_remaps_and_accounts_truncation():
+    tr = telemetry.Tracer(trace_id="run-x")
+    root = tr.span(telemetry.SPAN_RUN, parent=telemetry.ROOT)
+    root.__enter__()                      # stays open, like a live scope
+    t_lo = time.perf_counter_ns()
+    for i in range(6):
+        with tr.span(telemetry.SPAN_TASK, parent=root.context,
+                     partition=i):
+            pass
+    t_hi = time.perf_counter_ns()
+    ring = tr.export_ring(clock_offset_ns=1_000_000, process="w0",
+                          parent_remap={root.context.span_id: 0xC0DE},
+                          limit=4)
+    assert ring["clock_offset_ns"] == 1_000_000
+    assert ring["dropped"] == 2           # truncation is never silent
+    assert len(ring["spans"]) == 4
+    # the most recent spans are the ones kept (traces want the tail)
+    assert [s["attributes"]["partition"] for s in ring["spans"]] == \
+        [2, 3, 4, 5]
+    for s in ring["spans"]:
+        assert s["pid"] == os.getpid()
+        assert s["process"] == "w0"
+        assert s["parent_id"] == 0xC0DE   # re-parented off the open root
+        # rebased to ABSOLUTE parent-clock time: local clock + offset
+        assert t_lo + 1_000_000 <= s["start_ns"] <= s["end_ns"] \
+            <= t_hi + 1_000_000
+    # the exporter's own ring is untouched by building the shipped view
+    assert len(tr.spans(telemetry.SPAN_TASK)) == 6
+
+
+def test_adopt_remote_spans_rebases_and_rejects_noncanonical():
+    worker = telemetry.Tracer(trace_id="run-x")
+    for _ in range(3):
+        with worker.span(telemetry.SPAN_CLUSTER_TASK, parent=None):
+            pass
+    ring = worker.export_ring(process="w1")
+    bad = dict(ring["spans"][0], name="sparkdl.decode_chunkk")
+    coord = telemetry.Tracer(trace_id="run-x")
+    adopted, rejected = coord.adopt_remote_spans(ring["spans"] + [bad])
+    assert (adopted, rejected) == (3, 1)
+    got = coord.spans(telemetry.SPAN_CLUSTER_TASK)
+    assert len(got) == 3
+    for s in got:
+        assert s["process"] == "w1"       # keeps its origin labeling
+        assert s["end_ns"] >= s["start_ns"]
+    summ = coord.summary()
+    assert summ["remote_adopted"] == 3
+    assert summ["remote_rejected"] == 1
+    assert summ["spans_recorded"] == 3    # the bad record never landed
+
+
+def test_record_remote_allocates_ids_and_rejects_noncanonical():
+    tr = telemetry.Tracer(trace_id="run-x")
+    parent = telemetry.SpanContext("run-x", 0x77)
+    t0 = time.perf_counter_ns()
+    assert tr.record_remote(telemetry.SPAN_DECODE_CHUNK, parent,
+                            t0, t0 + 5_000_000, pid=12345,
+                            process="decode-12345", blobs=3) is True
+    (s,) = tr.spans(telemetry.SPAN_DECODE_CHUNK)
+    assert s["parent_id"] == 0x77 and s["trace_id"] == "run-x"
+    assert s["pid"] == 12345 and s["process"] == "decode-12345"
+    assert s["thread_id"] == 0 and s["thread_name"] == "decode-12345"
+    assert s["attributes"] == {"blobs": 3}
+    assert s["span_id"] != 0x77           # allocated HERE, pid-salted
+    assert s["span_id"] >> 40 == os.getpid()
+    # non-canonical: rejected + counted, never raised (runtime path)
+    assert tr.record_remote("sparkdl.decode_chunkk", parent,
+                            t0, t0, pid=1) is False
+    assert tr.summary()["remote_rejected"] == 1
+
+
+def test_remote_span_wire_record_requires_canonical_name():
+    rec = telemetry.remote_span(telemetry.SPAN_DECODE_CHUNK,
+                                100, 200, pid=7, blobs=2)
+    assert rec == {"name": telemetry.SPAN_DECODE_CHUNK,
+                   "start_ns": 100, "end_ns": 200, "pid": 7,
+                   "attributes": {"blobs": 2}}
+    assert telemetry.remote_span(telemetry.SPAN_DECODE_CHUNK, 1, 2
+                                 )["pid"] == os.getpid()
+    with pytest.raises(ValueError, match="canonical"):
+        telemetry.remote_span("sparkdl.decode_chunkk", 0, 1)
+
+
+def test_clock_handshake_over_a_pipe():
+    import multiprocessing as mp
+
+    parent, child = mp.get_context("spawn").Pipe()
+    try:
+        def _answer():
+            tag, t0 = parent.recv()
+            assert tag == "clock"
+            assert isinstance(t0, int)
+            parent.send(time.perf_counter_ns())
+
+        t = threading.Thread(target=_answer)
+        t.start()
+        offset = telemetry.clock_handshake(child)
+        t.join()
+        # same process, same CLOCK_MONOTONIC: the estimated offset is
+        # bounded by the pipe round-trip (generous CI slack)
+        assert abs(offset) < 100_000_000
+    finally:
+        parent.close()
+        child.close()
+    # a dead peer (or one that never answers) degrades to 0, not a hang
+    a, b = mp.get_context("spawn").Pipe()
+    a.close()
+    assert telemetry.clock_handshake(b, timeout_s=0.1) == 0
+    b.close()
+
+
+def test_chrome_trace_process_groups_only_after_remote_merge():
+    tr = telemetry.Tracer(trace_id="run-x")
+    with tr.span(telemetry.SPAN_TASK):
+        pass
+    # purely local: NO process_name metadata — the pre-merge shape
+    events = tr.chrome_trace()["traceEvents"]
+    assert not any(e["name"] == "process_name" for e in events)
+    tr.record_remote(telemetry.SPAN_DECODE_CHUNK,
+                     telemetry.SpanContext("run-x", 1), 0, 10,
+                     pid=424242, process="decode-424242")
+    events = tr.chrome_trace()["traceEvents"]
+    groups = {e["pid"]: e["args"]["name"] for e in events
+              if e["ph"] == "M" and e["name"] == "process_name"}
+    assert groups == {os.getpid(): "coordinator",
+                      424242: "decode-424242"}

@@ -1,0 +1,10 @@
+"""Seeded violation: non-canonical span name shipped across a process
+boundary (span-names) — the adopting tracer would reject it and the
+span would vanish from the merged timeline."""
+
+from sparkdl_tpu.core import telemetry
+
+
+def ship(conn, t0_ns, t1_ns):
+    conn.send(telemetry.remote_span('sparkdl.decode_chunkk',
+                                    t0_ns, t1_ns))

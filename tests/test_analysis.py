@@ -83,6 +83,7 @@ EXPECTED_FIXTURE_RULES = {
                                "thread-lifecycle"},
     "trainer_fetch.py": {"blocking-fetch-in-fit"},
     "span_name_typo.py": {"span-names"},
+    "remote_span_name.py": {"span-names"},
     "health_bare_string.py": {"health-constants"},
     "slo_metric_typo.py": {"slo-metrics"},
     "state/durability.py": {"atomic-write"},
