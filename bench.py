@@ -929,6 +929,133 @@ def bench_tracing_overhead(name="EfficientNetB0", n_images=256,
     }
 
 
+def bench_autoscale(n_flood=10, n_paid=2, sleep_s=0.25):
+    """ISSUE 16: elastic capacity, two measurements in one record.
+
+    (1) Cluster elasticity — a hand-driven ``autoscale_tick`` against a
+    hot windowed queue-wait p99: scale-up latency (decision → the new
+    worker spawned and joined dispatch) and graceful-drain duration
+    (drain start → clean snapshot-shipping exit) from the router's
+    autoscale event ledger.
+
+    (2) Per-tenant fairness under sustained overload — a flooding
+    tenant vs a weighted light tenant on the executor choke point: the
+    light tenant's queue-wait p99 alone (before) and mid-flood (after),
+    plus the flood's own tail, read from the per-tenant metric series
+    the fair queueing emits.
+    """
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    from sparkdl_tpu.cluster import router as cluster_router
+    from sparkdl_tpu.core import executor, telemetry
+    from sparkdl_tpu.core.model_function import ModelFunction, TensorSpec
+    from sparkdl_tpu.engine.dataframe import EngineConfig
+
+    out = {}
+
+    # -- (1) cluster elasticity: hot tick -> spawn, cold tick -> drain
+    saved = EngineConfig.snapshot()
+    try:
+        EngineConfig.cluster_autoscale = True
+        EngineConfig.cluster_min_workers = 1
+        EngineConfig.cluster_max_workers = 2
+        EngineConfig.autoscale_cooldown_s = 0.001
+        router = cluster_router.ClusterRouter(workers=1)
+        router._autoscale_stop.set()  # ticks driven by hand, not the loop
+        if router._autoscale_thread is not None:
+            router._autoscale_thread.join(timeout=10)
+        try:
+            with telemetry.Telemetry(out_dir=""):
+                for _ in range(16):
+                    telemetry.observe(telemetry.M_QUEUE_WAIT_S, 1.0)
+                t0 = time.monotonic()
+                assert router.autoscale_tick() == "up"
+                out["scale_up_s"] = round(time.monotonic() - t0, 4)
+            time.sleep(0.01)  # past the (tiny) cooldown
+            # scope closed: no windowed p99 reads as cold -> drain
+            assert router.autoscale_tick() == "down"
+            deadline = time.monotonic() + 30
+            drained = []
+            while time.monotonic() < deadline and not drained:
+                drained = [e for e in router.autoscale_events
+                           if e["action"] == "drained"]
+                time.sleep(0.02)
+            out["drain_s"] = (round(drained[0]["drain_s"], 4)
+                              if drained else None)
+            out["autoscale_events"] = [e["action"]
+                                       for e in router.autoscale_events]
+        finally:
+            router.close()
+    finally:
+        EngineConfig.restore(saved)
+        cluster_router.shutdown()
+
+    # -- (2) tenant fairness: paid p99 alone vs mid-flood
+    saved = EngineConfig.snapshot()
+    executor.reset()
+    try:
+        EngineConfig.coalesce_max_rows = 4  # small cap: DRR arbitrates
+        EngineConfig.executor_tenant_weights = {"paid": 8}
+        rng = np.random.default_rng(0)
+        w = jnp.asarray(rng.normal(size=(6, 3)).astype(np.float32))
+
+        def apply_fn(vs, x):
+            x = jax.pure_callback(lambda a: (time.sleep(sleep_s), a)[1],
+                                  jax.ShapeDtypeStruct(x.shape, x.dtype),
+                                  x)
+            return jnp.tanh(x @ vs)
+
+        mf = ModelFunction(apply_fn, w, TensorSpec((None, 6), "float32"),
+                           name="bench_autoscale_fairness")
+
+        def submit(tenant, seed):
+            executor.execute(
+                mf,
+                np.random.default_rng(seed).normal(
+                    size=(2, 6)).astype(np.float32),
+                batch_size=32, tenant=tenant)
+
+        def tenant_p99(snap, tenant):
+            h = snap["histograms"].get(
+                telemetry.tenant_queue_wait_metric(tenant))
+            return None if h is None else h.get("p99")
+
+        def fan(pairs, stagger_after=None):
+            threads = [threading.Thread(target=submit, args=p)
+                       for p in pairs]
+            for i, t in enumerate(threads):
+                if stagger_after is not None and i == stagger_after:
+                    time.sleep(0.05)  # the flood is queued first
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+
+        with telemetry.Telemetry(out_dir="") as tel:
+            fan([("paid", 100 + i) for i in range(max(n_paid, 4))])
+            before = tenant_p99(tel.metrics.window_snapshot(), "paid")
+        executor.reset()
+        with telemetry.Telemetry(out_dir="") as tel:
+            fan([("flood", i) for i in range(n_flood)]
+                + [("paid", 100 + i) for i in range(n_paid)],
+                stagger_after=n_flood)
+            snap = tel.metrics.window_snapshot()
+        out["tenant_paid_p99_before_s"] = (
+            None if before is None else round(before, 4))
+        after = tenant_p99(snap, "paid")
+        out["tenant_paid_p99_overload_s"] = (
+            None if after is None else round(after, 4))
+        flood = tenant_p99(snap, "flood")
+        out["tenant_flood_p99_overload_s"] = (
+            None if flood is None else round(flood, 4))
+    finally:
+        executor.reset()
+        EngineConfig.restore(saved)
+    return out
+
+
 def bench_precision_featurize(name="EfficientNetB0", n_images=128,
                               size=(224, 224), batch_size=64):
     """ISSUE 12 satellite: fp32 / bf16 / int8 featurize throughput AND
@@ -1379,6 +1506,20 @@ def main():
                  overhead_frac=round(tr["overhead_frac"], 4),
                  remote_adopted=tr.get("remote_adopted"),
                  workers_shipped=tr.get("workers_shipped"))
+            # elastic capacity (ISSUE 16): autoscale decision->join
+            # latency + graceful-drain duration from the event ledger,
+            # and the weighted light tenant's queue-wait p99 before vs
+            # during a sustained flood (fair queueing holding the line)
+            au = bench_autoscale()
+            emit("autoscale scale-up latency (1->2 workers, hot "
+                 "queue-wait p99)", au["scale_up_s"], "seconds",
+                 drain_s=au["drain_s"],
+                 autoscale_events=au["autoscale_events"],
+                 tenant_paid_p99_before_s=au["tenant_paid_p99_before_s"],
+                 tenant_paid_p99_overload_s=(
+                     au["tenant_paid_p99_overload_s"]),
+                 tenant_flood_p99_overload_s=(
+                     au["tenant_flood_p99_overload_s"]))
 
             # raw-speed inference (ISSUE 12): the precision ladder —
             # fp32/bf16/int8 throughput AND max output delta, one record

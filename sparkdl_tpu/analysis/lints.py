@@ -547,3 +547,67 @@ class AtomicWriteRule(Rule):
                 "place — a crash mid-write leaves a torn file; write to "
                 "a tmp path and os.replace it over the destination"))
         return out
+
+
+# ---------------------------------------------------------------------------
+# tenant-tag (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+#: The online plane: every serving request is SOME tenant's request.
+#: Batch callers (ml/engine/...) inherit the ambient tenant_scope or
+#: the EngineConfig default, so only serving/ is in scope — an online
+#: request with no tag burns the shared "default" lane's quota, which
+#: under deficit-round-robin lets one client starve the rest invisibly.
+TENANT_SCOPES = ("serving",)
+
+
+def untagged_execute_calls(tree: ast.AST) -> List[int]:
+    """Lines of ``executor.execute(...)`` (or bare ``execute(...)``)
+    calls with neither a ``tenant=`` keyword nor a ``**kwargs`` spread
+    (a spread may carry the tag; it is not statically checkable and is
+    skipped, same stance as dynamic span names)."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        is_execute = (
+            (isinstance(f, ast.Attribute) and f.attr == "execute"
+             and isinstance(f.value, ast.Name)
+             and f.value.id == "executor")
+            or (isinstance(f, ast.Name) and f.id == "execute"))
+        if not is_execute:
+            continue
+        kw_names = {kw.arg for kw in node.keywords}
+        if "tenant" in kw_names or None in kw_names:
+            continue
+        out.append(node.lineno)
+    return sorted(out)
+
+
+@register
+class TenantTagRule(Rule):
+    id = "tenant-tag"
+    title = "serving-plane executor.execute() must carry a tenant tag"
+    rationale = (
+        "The executor's fair-queueing coalescer arbitrates by tenant "
+        "(deficit-round-robin within each priority lane, "
+        "docs/RESILIENCE.md 'Per-tenant fair queueing'): an online "
+        "request submitted without `tenant=` lands in the shared "
+        "default lane, where one client's flood starves every other "
+        "untagged client with no per-tenant metric series to show it. "
+        "The serving plane must thread its caller's tag — even "
+        "`tenant=None` (resolve via the ambient scope) is an explicit, "
+        "visible decision.")
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        parts = set(pathlib.PurePath(src.rel).parts)
+        if not parts & set(TENANT_SCOPES):
+            return []
+        return [self.finding(
+            src, line,
+            "executor.execute() on the serving plane without a tenant= "
+            "argument — the request burns the shared default lane's "
+            "fair-queueing quota; thread the caller's tenant tag "
+            "(tenant=None to adopt the ambient tenant_scope)")
+            for line in untagged_execute_calls(src.tree)]

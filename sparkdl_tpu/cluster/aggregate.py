@@ -59,9 +59,29 @@ def build_snapshot(worker: str, pid: int, tel: Any, monitor: Any, *,
         "trace": tel.tracer.summary(),
         "phases": dict(phases or {}),
     }
+    tenants = _tenant_section(snap["metrics"])
+    if tenants:
+        snap["tenants"] = tenants
     if span_ring is not None:
         snap["span_ring"] = span_ring
     return snap
+
+
+def _tenant_section(metrics: Dict[str, Any]) -> Dict[str, Any]:
+    """Per-tenant queue-wait view, derived from the per-tenant histogram
+    series ``core/executor.py`` emits (``sparkdl.executor.queue_wait_s.
+    <tenant>``). Empty — and the section absent — when no non-default
+    tenant ran, keeping single-tenant snapshots byte-identical."""
+    prefix = telemetry.M_QUEUE_WAIT_S + "."
+    out: Dict[str, Any] = {}
+    for name, hist in ((metrics or {}).get("histograms") or {}).items():
+        if name.startswith(prefix):
+            out[name[len(prefix):]] = {
+                "count": hist.get("count", 0),
+                "sum_s": hist.get("sum", 0.0),
+                "p99_s": hist.get("p99"),
+            }
+    return dict(sorted(out.items()))
 
 
 def sum_canonical_counters(snapshots: Sequence[Dict[str, Any]]
@@ -94,7 +114,8 @@ def sum_health_counters(snapshots: Sequence[Dict[str, Any]]
 
 
 def merge_snapshots(snapshots: Sequence[Dict[str, Any]],
-                    lost_workers: Sequence[str] = ()
+                    lost_workers: Sequence[str] = (),
+                    autoscale_events: Sequence[Dict[str, Any]] = ()
                     ) -> Dict[str, Any]:
     """Fold per-worker snapshots into ONE ``cluster`` report section.
 
@@ -111,6 +132,15 @@ def merge_snapshots(snapshots: Sequence[Dict[str, Any]],
     silent — plus one ``span_rings_lost`` entry per worker that died
     without shipping its final snapshot (``lost_workers``, from the
     router). Off-path reports keep their exact pre-tracing shape.
+
+    With the elastic-capacity plane active, ``autoscale_events`` (the
+    router's ordered spawn/drain ledger) becomes an ``autoscale``
+    subsection — the event list verbatim plus scale-up/scale-down/drain
+    tallies — and any per-tenant queue-wait series in the worker
+    snapshots merge into a ``tenants`` subsection (counts summed;
+    ``p99_s`` is the WORST worker's p99, since percentiles cannot be
+    merged exactly across independent histograms). Both keys are absent
+    when the features are off.
     """
     snapshots = [s for s in snapshots if s]
     health_totals = sum_health_counters(snapshots)
@@ -143,16 +173,44 @@ def merge_snapshots(snapshots: Sequence[Dict[str, Any]],
                 for s in snapshots if s.get("span_ring") is not None},
             "span_rings_lost": sorted(lost_workers),
         }
+    tenants: Dict[str, Dict[str, Any]] = {}
+    for s in snapshots:
+        for tenant, view in (s.get("tenants") or {}).items():
+            agg = tenants.setdefault(
+                tenant, {"count": 0, "sum_s": 0.0, "p99_s": None})
+            agg["count"] += view.get("count", 0)
+            agg["sum_s"] = round(agg["sum_s"] + view.get("sum_s", 0.0), 9)
+            p99 = view.get("p99_s")
+            if p99 is not None and (agg["p99_s"] is None
+                                    or p99 > agg["p99_s"]):
+                agg["p99_s"] = p99
+    if tenants:
+        out["tenants"] = dict(sorted(tenants.items()))
+    if autoscale_events:
+        events = [dict(e) for e in autoscale_events]
+        out["autoscale"] = {
+            "events": events,
+            "scale_ups": sum(1 for e in events
+                             if e.get("action") == "spawn"
+                             and e.get("reason") == "scale_up"),
+            "scale_downs": sum(1 for e in events
+                               if e.get("action") == "draining"
+                               and e.get("reason") == "scale_down"),
+            "drained": sum(1 for e in events
+                           if e.get("action") == "drained"),
+        }
     return out
 
 
 def merged_run_report(tel: Any, snapshots: Sequence[Dict[str, Any]],
                       health_monitor: Any = None,
-                      lost_workers: Sequence[str] = ()
+                      lost_workers: Sequence[str] = (),
+                      autoscale_events: Sequence[Dict[str, Any]] = ()
                       ) -> Dict[str, Any]:
     """The coordinator's normal ``RunReport`` plus the merged
     ``cluster`` section — one artifact for the whole cluster run."""
     report = telemetry.RunReport.build(tel, health_monitor)
     report["cluster"] = merge_snapshots(snapshots,
-                                        lost_workers=lost_workers)
+                                        lost_workers=lost_workers,
+                                        autoscale_events=autoscale_events)
     return report

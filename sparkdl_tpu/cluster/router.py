@@ -78,6 +78,11 @@ _MP_CTX = mp.get_context("spawn")
 # latency) and worker join budget at close.
 _WAIT_POLL_S = 0.05
 _JOIN_TIMEOUT_S = 10.0
+# Autoscaler thread tick, and the grace a draining worker gets to finish
+# its in-flight tasks before it is torn down hard (DrainTimeout: its
+# tasks then take the ordinary lost-worker re-dispatch path).
+_AUTOSCALE_TICK_S = 0.25
+_DRAIN_GRACE_S = 60.0
 
 _run_ids = itertools.count(1)
 
@@ -113,15 +118,19 @@ class _Task:
     everything needed to re-dispatch it after a worker death."""
 
     __slots__ = ("task_id", "index", "token", "payload", "rows", "ctx",
-                 "event", "result", "error", "worker", "redispatches")
+                 "tenant", "event", "result", "error", "worker",
+                 "redispatches")
 
     def __init__(self, index: int, token: str, payload: bytes,
-                 rows: int, ctx=None) -> None:
+                 rows: int, ctx=None, tenant: Optional[str] = None) -> None:
         self.task_id = 0
         self.index = index
         self.token = token
         self.payload = payload
         self.rows = rows
+        # the job's tenant tag (EngineConfig.job_tenant): rides the task
+        # message so worker-side executor metrics stay tenant-attributed
+        self.tenant = tenant
         # the dispatch span's context, captured at submit: rides every
         # (re-)dispatch of this task so the worker-side span parents
         # under the SAME coordinator span a hedge/redispatch belongs to
@@ -139,7 +148,8 @@ class _Worker:
     in-flight task ids / outstanding rows (the load signal)."""
 
     __slots__ = ("wid", "proc", "queue", "conn", "clock", "assigned",
-                 "tokens", "outstanding_rows", "finished", "lost")
+                 "tokens", "outstanding_rows", "finished", "lost",
+                 "draining", "drain_started", "drain_reason", "pilled")
 
     def __init__(self, wid: int, proc: Any, queue: Any, conn: Any,
                  clock: Any) -> None:
@@ -153,6 +163,15 @@ class _Worker:
         self.outstanding_rows = 0
         self.finished = False  # final snapshot received
         self.lost = False      # died without a final snapshot
+        # WorkerDraining state: no new dispatches; in-flight tasks run
+        # to completion, then the router pills the worker, which ships
+        # its final snapshot and exits cleanly (never a worker-lost
+        # re-dispatch). Entered on a preemption notice (worker-side
+        # SIGTERM-with-warning) or an autoscaler scale-down order.
+        self.draining = False
+        self.drain_started = 0.0
+        self.drain_reason = ""
+        self.pilled = False    # poison pill already sent
 
 
 class ClusterRouter:
@@ -176,7 +195,8 @@ class ClusterRouter:
     """
 
     def __init__(self, workers: int, inflight: Optional[int] = None,
-                 run_id: Optional[str] = None) -> None:
+                 run_id: Optional[str] = None,
+                 autoscale: Optional[bool] = None) -> None:
         if workers < 1:
             raise ValueError(
                 f"cluster router needs >= 1 worker, got {workers}")
@@ -200,10 +220,11 @@ class ClusterRouter:
 
         config = EngineConfig.snapshot()
         # a worker must never recurse into its own cluster, journal
-        # coordinator-owned state, or nest a decode pool per worker
+        # coordinator-owned state, nest a decode pool per worker, or run
+        # its own autoscaler (elasticity is coordinator-owned)
         config.update(cluster_workers=0, cluster_inflight_partitions=None,
                       decode_workers=0, decode_pool_inflight=None,
-                      durable_dir=None)
+                      durable_dir=None, cluster_autoscale=False)
         import cloudpickle
 
         # the coordinator's root span context ships in the boot blob:
@@ -221,6 +242,17 @@ class ClusterRouter:
         self._finals: List[Dict[str, Any]] = []
         self._sem = threading.BoundedSemaphore(self.inflight)
         self._closed = False
+        # -- elastic capacity (docs/DISTRIBUTED.md "Elastic capacity") --
+        # Live worker indices keep growing past the initial range, so a
+        # replacement never reuses a retired worker's name; the event
+        # history is merged into the cluster report at close().
+        self._autoscale = (bool(EngineConfig.cluster_autoscale)
+                           if autoscale is None else bool(autoscale))
+        self._next_index = self.workers
+        self._last_scale_ts = float("-inf")
+        self.autoscale_events: List[Dict[str, Any]] = []
+        self._autoscale_stop = threading.Event()
+        self._autoscale_thread: Optional[threading.Thread] = None
         # bench accounting: wall time inside dispatch vs worker-measured
         # op-chain time (their gap is the router's overhead)
         self.dispatch_s_total = 0.0
@@ -254,6 +286,12 @@ class ClusterRouter:
             target=self._collect, name="sparkdl-cluster-collector",
             daemon=True)
         self._collector.start()
+        self._gauge_workers_locked_free()
+        if self._autoscale:
+            self._autoscale_thread = threading.Thread(
+                target=self._autoscale_loop,
+                name="sparkdl-cluster-autoscaler", daemon=True)
+            self._autoscale_thread.start()
 
     def _spawn(self, index: int) -> _Worker:
         queue = _MP_CTX.Queue()
@@ -365,8 +403,11 @@ class ClusterRouter:
                 raise resilience.ClusterWorkerLost(
                     "cluster router closed while a dispatch was waiting "
                     "for an in-flight slot")
+        from sparkdl_tpu.engine.dataframe import EngineConfig
+
         task = _Task(index, token, payload, batch.num_rows,
-                     telemetry.current_context())
+                     telemetry.current_context(),
+                     tenant=EngineConfig.job_tenant)
         with self._lock:
             if self._closed:
                 self._sem.release()
@@ -398,9 +439,18 @@ class ClusterRouter:
         partition — the precise re-dispatch path is what the injection
         exercises. Anti-affinity is best-effort: with every live worker
         excluded, landing somewhere beats failing the attempt."""
-        live = [w for w in self._workers if not w.lost and not w.finished]
+        live = [w for w in self._workers
+                if not w.lost and not w.finished and not w.draining]
         candidates = [w for w in live if w.wid not in exclude] or live
         if not candidates:
+            if any(w.draining and not w.lost and not w.finished
+                   for w in self._workers):
+                # every survivor is draining: the work itself is fine —
+                # RETRYABLE, and a replacement/finished drain will take
+                # the retry (never the worker-lost re-dispatch story)
+                raise resilience.WorkerDraining(
+                    f"every live cluster worker is draining; partition "
+                    f"{task.index} must wait for a replacement")
             raise resilience.ClusterWorkerLost(
                 f"no live cluster workers to run partition {task.index}")
         worker = min(candidates,
@@ -411,8 +461,13 @@ class ClusterRouter:
             worker.tokens.add(task.token)
         crash = resilience.should_fire("cluster_worker_kill",
                                        partition=task.index)
+        # SIGTERM-with-warning (spot-VM preemption): the worker still
+        # RUNS this task, then drains — zero re-execution by design
+        preempt = resilience.should_fire("cluster_worker_preempt",
+                                         partition=task.index)
         worker.queue.put(("task", task.task_id, task.index, task.token,
-                          task.payload, crash, task.ctx))
+                          task.payload, crash, preempt, task.tenant,
+                          task.ctx))
         worker.assigned.add(task.task_id)
         worker.outstanding_rows += task.rows
         task.worker = worker.wid
@@ -516,12 +571,23 @@ class ClusterRouter:
                 worker.finished = True
                 self._finals.append(msg[2])
             return
+        if kind == "draining":
+            # SIGTERM-with-warning reached the worker: stop dispatching
+            # to it, let its in-flight tasks finish, pill it once empty
+            # — a drain, never a ClusterWorkerLost re-dispatch storm
+            self._begin_drain(worker, reason="preemption")
+            return
         task_id = msg[1]
         with self._lock:
             task = self._pending.pop(task_id, None)
             if task is not None:
                 self._discount_locked(task)
             total = self._outstanding_locked()
+            if (worker.draining and not worker.assigned
+                    and not worker.pilled and not self._closed):
+                # last in-flight task just finished: retire the worker
+                # (it ships its final snapshot and EOFs cleanly)
+                self._pill_locked(worker)
         if task is None:
             return  # re-dispatch duplicate or abandoned attempt
         if kind == "ok":
@@ -536,6 +602,92 @@ class ClusterRouter:
         self._sem.release()
         self._gauge(total)
 
+    def _pill_locked(self, worker: _Worker) -> None:
+        """Send the poison pill to one worker (caller holds the lock).
+        Drain is PILL-driven: the worker never self-exits on SIGTERM, so
+        a task sitting unread in its queue can never be stranded — the
+        pill goes out only once ``assigned`` is empty."""
+        try:
+            worker.queue.put(None)
+        except ValueError:  # pragma: no cover - queue reaped concurrently
+            return
+        worker.pilled = True
+
+    def _begin_drain(self, worker: _Worker, reason: str) -> None:
+        """Move one worker into the WorkerDraining state (idempotent).
+        Dispatch stops immediately; the pill goes out as soon as the
+        worker holds no in-flight tasks. A preemption drain that would
+        leave the live set below the floor spawns a replacement."""
+        spawned: Optional[_Worker] = None
+        with self._lock:
+            if (worker.draining or worker.lost or worker.finished
+                    or self._closed):
+                return
+            worker.draining = True
+            worker.drain_started = time.monotonic()
+            worker.drain_reason = reason
+            if not worker.assigned and not worker.pilled:
+                self._pill_locked(worker)
+            if reason == "preemption":
+                spawned = self._ensure_capacity_locked()
+        health.record(health.CLUSTER_WORKER_DRAINING,
+                      worker=worker.proc.name, reason=reason)
+        if reason == "preemption":
+            health.record(health.CLUSTER_PREEMPTION_NOTICE,
+                          worker=worker.proc.name)
+        self._note_autoscale_event("draining", worker=worker.proc.name,
+                                   reason=reason)
+        logger.warning("cluster worker %s draining (%s): %d in-flight "
+                       "task(s) to finish", worker.proc.name, reason,
+                       len(worker.assigned))
+        if spawned is not None:
+            self._after_spawn(spawned, reason="replace_preempted")
+
+    def _ensure_capacity_locked(self) -> Optional[_Worker]:
+        """Spawn a replacement when a preemption drain would leave the
+        live set below the floor (caller holds the lock). Floor =
+        ``cluster_min_workers`` with the autoscaler armed, else the
+        configured worker count (static capacity must stay static)."""
+        from sparkdl_tpu.engine.dataframe import EngineConfig
+
+        floor = (EngineConfig.cluster_min_workers if self._autoscale
+                 else self.workers)
+        live = sum(1 for w in self._workers
+                   if not w.lost and not w.finished and not w.draining)
+        if live >= floor:
+            return None
+        spawned = self._spawn(self._next_index)
+        # sparkdl: allow(unguarded-shared-write): caller holds self._lock (the _locked-suffix contract)
+        self._next_index += 1
+        self._workers.append(spawned)
+        return spawned
+
+    def _after_spawn(self, worker: _Worker, reason: str) -> None:
+        """Post-spawn bookkeeping done OUTSIDE the lock: wake the
+        collector (it rebuilds its conn map per iteration, so the new
+        worker's pipes join the multiplex on the next pass) and record
+        the event."""
+        try:
+            self._wake_w.send_bytes(b"w")
+        except (OSError, ValueError):  # pragma: no cover - closing
+            pass
+        self._gauge_workers_locked_free()
+        self._note_autoscale_event("spawn", worker=worker.proc.name,
+                                   reason=reason)
+
+    def _gauge_workers_locked_free(self) -> None:
+        if telemetry.active() is None:
+            return
+        with self._lock:
+            live = sum(1 for w in self._workers
+                       if not w.lost and not w.finished and not w.draining)
+        telemetry.gauge_set(telemetry.M_CLUSTER_WORKERS, live)
+
+    def _note_autoscale_event(self, action: str, **ctx: Any) -> None:
+        with self._lock:
+            self.autoscale_events.append(
+                {"action": action, "t": time.monotonic(), **ctx})
+
     def _on_worker_eof(self, worker: _Worker) -> None:
         """A worker's pipe hit EOF. Clean exit (final already adopted,
         or the router is closing) just retires the conn; a DEATH marks
@@ -547,8 +699,11 @@ class ClusterRouter:
         redispatched: List[_Task] = []
         failed: List[_Task] = []
         lost = False
+        drained = False
         with self._lock:
             worker.conn = None
+            if worker.draining and worker.finished:
+                drained = True
             if not worker.finished and not self._closed:
                 lost = True
                 worker.lost = True
@@ -572,6 +727,20 @@ class ClusterRouter:
                         del self._pending[task_id]
                         task.error = e
                         failed.append(task)
+        if drained:
+            drain_s = time.monotonic() - worker.drain_started
+            logger.info("cluster worker %s drained cleanly in %.3fs (%s)",
+                        worker.proc.name, drain_s, worker.drain_reason)
+            health.record(health.CLUSTER_WORKER_DRAINED,
+                          worker=worker.proc.name,
+                          reason=worker.drain_reason,
+                          drain_s=round(drain_s, 4))
+            if telemetry.active() is not None:
+                telemetry.observe(telemetry.M_CLUSTER_DRAIN_S, drain_s)
+            self._note_autoscale_event("drained", worker=worker.proc.name,
+                                       reason=worker.drain_reason,
+                                       drain_s=round(drain_s, 4))
+            self._gauge_workers_locked_free()
         if lost:
             logger.warning(
                 "cluster worker %s died; re-dispatched %d in-flight "
@@ -589,6 +758,112 @@ class ClusterRouter:
             task.event.set()
             self._sem.release()
 
+    # -- the autoscaler -------------------------------------------------------
+
+    def _autoscale_loop(self) -> None:
+        while not self._autoscale_stop.wait(_AUTOSCALE_TICK_S):
+            if self._closed:
+                return
+            try:
+                self.autoscale_tick()
+            # sparkdl: allow(broad-retry): not a retry — a failed advisory tick is logged and the next tick re-evaluates from fresh telemetry
+            except Exception:  # noqa: BLE001 - a tick must never kill the loop
+                logger.exception("autoscale tick failed; continuing")
+
+    def autoscale_tick(self, now: Optional[float] = None) -> Optional[str]:
+        """One autoscaling decision (deterministically testable; the
+        background thread just calls this on a short tick). Signals:
+        the windowed queue-wait p99 from the live telemetry scope and
+        outstanding rows per live worker. Hysteresis = the wide gap
+        between the high and low water marks; anti-flap = the cooldown
+        since the last action, plus at most ONE drain in flight. Also
+        enforces the drain grace: a worker stuck draining past
+        ``_DRAIN_GRACE_S`` is torn down hard (DrainTimeout — its tasks
+        take the ordinary lost-worker re-dispatch path). Returns
+        ``"up"``, ``"down"``, or ``None``."""
+        from sparkdl_tpu.engine.dataframe import EngineConfig
+
+        if not self._autoscale or self._closed:
+            return None
+        EngineConfig.validate()
+        now = time.monotonic() if now is None else now
+        p99: Optional[float] = None
+        tel = telemetry.active()
+        if tel is not None:
+            snap = tel.metrics.window_snapshot(
+                EngineConfig.autoscale_window_s)
+            hist = snap["histograms"].get(telemetry.M_QUEUE_WAIT_S)
+            p99 = hist.get("p99") if hist else None
+        stuck: List[_Worker] = []
+        with self._lock:
+            if self._closed:
+                return None
+            live = [w for w in self._workers
+                    if not w.lost and not w.finished and not w.draining]
+            draining = [w for w in self._workers
+                        if w.draining and not w.lost and not w.finished]
+            for w in draining:
+                if now - w.drain_started > _DRAIN_GRACE_S:
+                    stuck.append(w)
+            n_live = len(live)
+            outstanding = sum(w.outstanding_rows for w in live)
+            idle = [w for w in live
+                    if not w.assigned and not w.outstanding_rows]
+        for w in stuck:
+            logger.warning(
+                "cluster worker %s exceeded the %.0fs drain grace; "
+                "terminating (DrainTimeout — in-flight tasks will "
+                "re-dispatch)", w.proc.name, _DRAIN_GRACE_S)
+            self._note_autoscale_event("drain_timeout",
+                                       worker=w.proc.name,
+                                       error="DrainTimeout")
+            w.proc.terminate()  # EOF reap marks it lost + re-dispatches
+        if now - self._last_scale_ts < EngineConfig.autoscale_cooldown_s:
+            return None
+        rows_per = (outstanding / n_live) if n_live else float("inf")
+        hot = ((p99 is not None
+                and p99 > EngineConfig.autoscale_queue_wait_high_s)
+               or rows_per > EngineConfig.autoscale_rows_per_worker_high)
+        cold = (p99 is None
+                or p99 < EngineConfig.autoscale_queue_wait_low_s)
+        if hot and n_live < EngineConfig.cluster_max_workers:
+            with self._lock:
+                if self._closed:
+                    return None
+                spawned = self._spawn(self._next_index)
+                self._next_index += 1
+                self._workers.append(spawned)
+                self._last_scale_ts = now
+            health.record(health.CLUSTER_SCALE_UP,
+                          worker=spawned.proc.name, workers=n_live + 1,
+                          queue_wait_p99_s=p99,
+                          rows_per_worker=round(rows_per, 1))
+            logger.warning(
+                "cluster autoscaler scaling UP to %d worker(s) "
+                "(queue-wait p99 %s, %.0f rows/worker)", n_live + 1,
+                f"{p99:.4f}s" if p99 is not None else "n/a", rows_per)
+            self._after_spawn(spawned, reason="scale_up")
+            return "up"
+        if (cold and not draining and idle
+                and n_live > EngineConfig.cluster_min_workers):
+            # retire the newest idle worker: drain is instant (nothing
+            # in flight), so the pill goes out right away
+            victim = max(idle, key=lambda w: w.wid)
+            with self._lock:
+                self._last_scale_ts = now
+            health.record(health.CLUSTER_SCALE_DOWN,
+                          worker=victim.proc.name, workers=n_live - 1,
+                          queue_wait_p99_s=p99)
+            logger.info(
+                "cluster autoscaler scaling DOWN to %d worker(s) "
+                "(queue-wait p99 %s; retiring idle %s)", n_live - 1,
+                f"{p99:.4f}s" if p99 is not None else "n/a",
+                victim.proc.name)
+            self._begin_drain(victim, reason="scale_down")
+            self._gauge_workers_locked_free()
+            return "down"
+        return None
+
     # -- lifecycle -----------------------------------------------------------
 
     def close(self) -> None:
@@ -597,6 +872,7 @@ class ClusterRouter:
         :attr:`cluster_report` / :attr:`run_report`. Idempotent; safe
         mid-stream (waiters fail with a RETRYABLE ClusterWorkerLost
         rather than hanging)."""
+        self._autoscale_stop.set()
         with self._lock:
             if self._closed:
                 return
@@ -627,6 +903,8 @@ class ClusterRouter:
         # being parked on an empty list
         self._wake_w.send_bytes(b"c")
         self._collector.join()
+        if self._autoscale_thread is not None:
+            self._autoscale_thread.join(timeout=_JOIN_TIMEOUT_S)
         for task in abandoned:
             task.error = resilience.ClusterWorkerLost(
                 "cluster router closed mid-stream")
@@ -647,10 +925,13 @@ class ClusterRouter:
                 ring = snap.get("span_ring")
                 if ring is not None:
                     tel.tracer.adopt_remote_spans(ring["spans"])
+        with self._lock:
+            scale_events = list(self.autoscale_events)
         self.cluster_report = aggregate.merge_snapshots(
-            finals, lost_workers=lost)
+            finals, lost_workers=lost, autoscale_events=scale_events)
         self.run_report = (
-            aggregate.merged_run_report(tel, finals, lost_workers=lost)
+            aggregate.merged_run_report(tel, finals, lost_workers=lost,
+                                        autoscale_events=scale_events)
             if tel is not None else None)
 
     def __enter__(self) -> "ClusterRouter":
@@ -672,7 +953,7 @@ class ClusterRouter:
 
 _router_lock = threading.Lock()
 _router: Optional[ClusterRouter] = None
-_router_key: Optional[Tuple[int, Optional[int]]] = None
+_router_key: Optional[Tuple[int, Optional[int], bool]] = None
 _last_router: Optional[ClusterRouter] = None
 
 
@@ -690,7 +971,8 @@ def maybe_router() -> Optional[ClusterRouter]:
     workers = EngineConfig.cluster_workers
     if not workers:
         return None
-    key = (workers, EngineConfig.cluster_inflight_partitions)
+    key = (workers, EngineConfig.cluster_inflight_partitions,
+           EngineConfig.cluster_autoscale)
     global _router, _router_key, _last_router
     with _router_lock:
         stale = _router

@@ -31,13 +31,23 @@ pools under the coordinator's pool).
 
 Protocol (parent -> worker queue):
   ``("ops", token, blob)``                      register an op chain
-  ``("task", task_id, index, token, ipc, crash, ctx)``  run one
-      partition; ``ctx`` is the coordinator's dispatch-span
-      ``SpanContext`` (None with tracing off) — the worker's
-      ``sparkdl.cluster_task`` span parents under it
+  ``("task", task_id, index, token, ipc, crash, preempt, tenant,
+  ctx)``  run one partition; ``ctx`` is the coordinator's
+      dispatch-span ``SpanContext`` (None with tracing off) — the
+      worker's ``sparkdl.cluster_task`` span parents under it;
+      ``preempt`` (the armed ``cluster_worker_preempt`` marker)
+      SIGTERMs this process BEFORE the task runs — the task still
+      completes, the drain is zero-recompute; ``tenant`` is the job's
+      fair-queueing tag (``EngineConfig.job_tenant``), entered as an
+      ``executor.tenant_scope`` around the op chain
   ``None``                                      poison pill
 (worker -> parent pipe):
   ``("ok", task_id, ipc, meta)`` / ``("err", task_id, type, msg, kind)``
+  ``("draining", worker_id)``                   SIGTERM-with-warning
+      received (spot-VM preemption): the router stops dispatching here
+      and pills this worker once its in-flight tasks finish — the
+      worker NEVER self-exits on SIGTERM (a task sitting unread in the
+      queue could be stranded otherwise; the drain is pill-driven)
   ``("final", worker_id, snapshot)``            last message before EOF
       (with tracing armed the snapshot carries this worker's span ring,
       rebased onto the coordinator's clock via the startup handshake on
@@ -120,11 +130,24 @@ def _worker_main(worker_id: int, tasks: Any, conn: Any, owner_pid: int,
 
     jax.config.update("jax_platforms", boot["platform"])
     from sparkdl_tpu.cluster import aggregate
-    from sparkdl_tpu.core import health, profiling, resilience, telemetry
+    from sparkdl_tpu.core import (executor, health, profiling, resilience,
+                                  telemetry)
     from sparkdl_tpu.engine.dataframe import EngineConfig
 
     EngineConfig.restore(boot["config"])
     name = f"sparkdl-cluster-{worker_id}"
+    # SIGTERM-with-warning (spot-VM preemption): the handler ONLY sets a
+    # flag — touching the result pipe from a signal frame could tear a
+    # message mid-send. The loop notices the flag at its next iteration
+    # (PEP 475: the signal interrupts a blocking queue get, which then
+    # resumes — worst case one _ORPHAN_POLL_S when idle, instant when
+    # busy) and notifies the router, which owns the drain.
+    preempted = {"flag": False, "sent": False}
+
+    def _on_sigterm(signum, frame):  # pragma: no cover - signal frame
+        preempted["flag"] = True
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
     # the coordinator's root span context (None = tracing off) and the
     # clock offset that maps this process's perf_counter_ns onto the
     # coordinator's — together they let this worker's spans merge onto
@@ -151,6 +174,15 @@ def _worker_main(worker_id: int, tasks: Any, conn: Any, owner_pid: int,
         # root — a no-op when tracing is off (coord_root is None)
         telemetry.attach(coord_root)
         while True:
+            if preempted["flag"] and not preempted["sent"]:
+                # tell the router we are draining, then KEEP processing:
+                # in-flight and already-queued tasks run to completion
+                # (zero re-execution); the router pills us once our
+                # in-flight set empties
+                preempted["sent"] = True
+                health.record(health.CLUSTER_PREEMPTION_NOTICE,
+                              worker=name)
+                conn.send(("draining", worker_id))
             try:
                 msg = tasks.get(timeout=_ORPHAN_POLL_S)
             except Empty:
@@ -164,11 +196,17 @@ def _worker_main(worker_id: int, tasks: Any, conn: Any, owner_pid: int,
                 _, token, blob = msg
                 ops_cache[token] = cloudpickle.loads(blob)
                 continue
-            _, task_id, index, token, payload, crash, ctx = msg
+            _, task_id, index, token, payload, crash, preempt, tenant, \
+                ctx = msg
             if crash:
                 # injected worker death (chaos leg): die as hard as a
                 # machine loss — no cleanup, no final snapshot
                 os.kill(os.getpid(), signal.SIGKILL)
+            if preempt:
+                # injected SIGTERM-with-warning: the flag is set before
+                # the task runs, so the drain notice goes out on the
+                # NEXT loop iteration — this task still completes
+                os.kill(os.getpid(), signal.SIGTERM)
             t0 = time.perf_counter()
             try:
                 ops = ops_cache[token]
@@ -176,10 +214,13 @@ def _worker_main(worker_id: int, tasks: Any, conn: Any, owner_pid: int,
                 # parent = the coordinator's sparkdl.cluster_dispatch
                 # span that shipped this task (ambient fallback when
                 # tracing is off), so the cross-process parent link is
-                # explicit, not inferred
-                with telemetry.span(telemetry.SPAN_CLUSTER_TASK,
-                                    parent=ctx, partition=index,
-                                    cluster_worker=worker_id):
+                # explicit, not inferred; the job's tenant tag scopes
+                # the op chain so worker-side executor metrics stay
+                # tenant-attributed
+                with executor.tenant_scope(tenant), \
+                        telemetry.span(telemetry.SPAN_CLUSTER_TASK,
+                                       parent=ctx, partition=index,
+                                       cluster_worker=worker_id):
                     for op in ops:
                         out = op(out)
                 result = _ipc_bytes(out)

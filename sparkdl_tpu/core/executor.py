@@ -285,6 +285,40 @@ class deadline_scope:
         _tls.deadline = self._prev
 
 
+#: Tenant tag for requests that carry none and run outside any scope.
+DEFAULT_TENANT = "default"
+
+
+def current_tenant() -> Optional[str]:
+    """The ambient tenant tag for THIS thread's executor calls (set by
+    :class:`tenant_scope`; cluster workers enter one per dispatched
+    partition so worker-side metrics stay tenant-attributed). None
+    outside a scope."""
+    return getattr(_tls, "tenant", None)
+
+
+class tenant_scope:
+    """Tag every executor call made on this thread with one tenant.
+    The fair-queueing coalescer schedules lanes per tenant
+    (deficit-round-robin within priority), so the tag decides whose
+    quota a request burns. Explicit ``execute(tenant=...)`` beats the
+    scope; the scope beats ``EngineConfig.executor_default_tenant``.
+    ``tenant_scope(None)`` is a no-op layer (ambient tag unchanged)."""
+
+    def __init__(self, tenant: Optional[str]) -> None:
+        self._tenant = tenant
+        self._prev: Optional[str] = None
+
+    def __enter__(self) -> "tenant_scope":
+        self._prev = getattr(_tls, "tenant", None)
+        if self._tenant is not None:
+            _tls.tenant = self._tenant
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        _tls.tenant = self._prev
+
+
 # ---------------------------------------------------------------------------
 # Requests and per-compiled-fn state
 # ---------------------------------------------------------------------------
@@ -310,12 +344,13 @@ class _Request:
 
     __slots__ = ("tree", "rows", "future", "token", "policy", "ctx",
                  "t_enqueue", "launched", "priority", "deadline",
-                 "is_probe", "breaker_noted")
+                 "tenant", "is_probe", "breaker_noted")
 
     def __init__(self, tree: Any, rows: int, token: Optional[Tuple],
                  policy: resilience.RetryPolicy,
                  priority: str = PRIORITY_BULK,
-                 deadline: Optional[resilience.Deadline] = None) -> None:
+                 deadline: Optional[resilience.Deadline] = None,
+                 tenant: str = DEFAULT_TENANT) -> None:
         self.tree = tree
         self.rows = rows
         self.future: "Future[Any]" = Future()
@@ -323,6 +358,7 @@ class _Request:
         self.policy = policy
         self.priority = priority
         self.deadline = deadline
+        self.tenant = tenant
         # True when this request is the breaker's half-open probe: its
         # outcome decides reopen-vs-close, and a probe that dies WITHOUT
         # reaching the device must release the probe slot (never wedge
@@ -362,11 +398,20 @@ class _FnState:
         self.pending: "deque[_Request]" = deque()
         self.pending_rows = 0       # incremental sum(r.rows for pending)
         self.pending_deadlines = 0  # queued requests carrying a deadline
+        # Deficit-round-robin credit per tenant (guarded by cond): rows
+        # each tenant may still release this scheduling round. Cleared
+        # for a tenant once it has nothing queued, so an idle tenant
+        # cannot bank unbounded credit.
+        self.tenant_deficit: Dict[str, float] = {}
         self.dedup: Dict[Tuple, _Request] = {}
         self.inflight = 0           # launches running (inline + coalesced)
         self.window_s: Optional[float] = None  # None = adaptive
         self.cap = batch_size
         self.overload: OverloadPolicy = _NO_OVERLOAD
+        # DRR weight per tenant (None = every tenant weight 1); snapshot
+        # of EngineConfig.executor_tenant_weights, refreshed per submit
+        # like the overload policy.
+        self.tenant_weights: Optional[Dict[str, int]] = None
         self.donate = False  # staged batches donated to their launches
         self.planner: Optional[batching.BucketPlanner] = None
         self.latency_ewma: Optional[float] = None
@@ -417,6 +462,8 @@ class DeviceExecutor:
                window_s: Optional[float], cap: int,
                prefetch: int, *, priority: str = PRIORITY_BULK,
                deadline: Optional[resilience.Deadline] = None,
+               tenant: str = DEFAULT_TENANT,
+               tenant_weights: Optional[Dict[str, int]] = None,
                overload: OverloadPolicy = _NO_OVERLOAD,
                donate: bool = False,
                planner: Optional[batching.BucketPlanner] = None) -> Any:
@@ -427,6 +474,9 @@ class DeviceExecutor:
         ``priority`` picks the lane (interactive drains first, bulk sheds
         first); ``deadline`` bounds the blocking-admission wait and lets
         the coalescer drop this request unlaunched once expired;
+        ``tenant`` is the fair-queueing tag — within a priority lane the
+        coalescer releases queued rows per tenant by deficit-round-robin
+        (weights from ``EngineConfig.executor_tenant_weights``);
         ``overload`` carries the admission/breaker knob snapshot;
         ``donate`` donates staged batches to their launches (its jitted
         variant is a distinct compiled fn, hence a distinct coalescing
@@ -451,6 +501,7 @@ class DeviceExecutor:
             state.window_s = window_s
             state.cap = cap
             state.overload = overload
+            state.tenant_weights = tenant_weights
             state.donate = donate
             state.planner = planner
             is_probe = self._breaker_admit_locked(state)
@@ -513,11 +564,12 @@ class DeviceExecutor:
                     else:
                         if overload.bounded:
                             self._admit_locked(state, rows, priority,
-                                               deadline)
+                                               deadline, tenant)
                             self._note_admitted()
                         request = _Request(tree, rows, token, policy,
                                            priority=priority,
-                                           deadline=deadline)
+                                           deadline=deadline,
+                                           tenant=tenant)
                         request.is_probe = is_probe
                         state.pending.append(request)
                         state.pending_rows += rows
@@ -720,11 +772,12 @@ class DeviceExecutor:
             self._admitted += 1
         self._note_shed_rate()
 
-    def _note_shed(self, rows: int, priority: str, reason: str) -> None:
+    def _note_shed(self, rows: int, priority: str, reason: str,
+                   tenant: str = DEFAULT_TENANT) -> None:
         with self._lock:
             self._shed += 1
         health.record(health.EXECUTOR_SHED, rows=rows, priority=priority,
-                      reason=reason)
+                      reason=reason, tenant=tenant)
         self._note_shed_rate()
 
     def _note_shed_rate(self) -> None:
@@ -739,7 +792,8 @@ class DeviceExecutor:
     # -- admission control ----------------------------------------------------
 
     def _admit_locked(self, state: _FnState, rows: int, priority: str,
-                      deadline: Optional[resilience.Deadline]) -> None:
+                      deadline: Optional[resilience.Deadline],
+                      tenant: str = DEFAULT_TENANT) -> None:
         """Enforce the per-fn queue bound (caller holds state.cond).
 
         Over the bound, shed mode fails fast (interactive first displaces
@@ -763,7 +817,8 @@ class DeviceExecutor:
                 if (priority == PRIORITY_INTERACTIVE
                         and self._evict_bulk_locked(state)):
                     continue  # re-check: the eviction may have made room
-                self._note_shed(rows, priority, reason="admission")
+                self._note_shed(rows, priority, reason="admission",
+                                tenant=tenant)
                 raise ExecutorOverloaded(
                     f"executor queue for {getattr(state.model, 'name', '?')} "
                     f"is full ({len(state.pending)} request(s), "
@@ -807,7 +862,8 @@ class DeviceExecutor:
             if r.token is not None and state.dedup.get(r.token) is r:
                 del state.dedup[r.token]
             self._note_queued(-1)
-            self._note_shed(r.rows, r.priority, reason="displaced")
+            self._note_shed(r.rows, r.priority, reason="displaced",
+                            tenant=r.tenant)
             r.future.set_exception(ExecutorOverloaded(
                 f"{r.rows}-row bulk request displaced from the full "
                 f"executor queue by an interactive arrival"))
@@ -1022,8 +1078,8 @@ class DeviceExecutor:
                     # launches) and partition survivors into lanes —
                     # never per-item deque.remove(), which would make a
                     # deep drain O(n^2) exactly when the queue is deep
-                    lanes: Dict[str, List[_Request]] = \
-                        {p: [] for p in PRIORITIES}
+                    lanes: Dict[str, Dict[str, List[_Request]]] = \
+                        {p: {} for p in PRIORITIES}
                     for r in state.pending:
                         if r.deadline is not None and r.deadline.expired():
                             if (r.token is not None
@@ -1031,21 +1087,38 @@ class DeviceExecutor:
                                 del state.dedup[r.token]
                             expired.append(r)
                         else:
-                            lanes[r.priority].append(r)
-                    # interactive lane drains first, FIFO within a lane;
-                    # the first over-cap request (and everything behind
-                    # it) waits for the next round
+                            lanes[r.priority].setdefault(
+                                r.tenant, []).append(r)
+                    # interactive lane drains first; within a lane, one
+                    # tenant is plain FIFO (the pre-fairness fast path,
+                    # byte-identical release order) and several tenants
+                    # release by deficit-round-robin — a flooding tenant
+                    # saturates only its weighted share of the cap
                     overflow = False
+                    throttled: List[str] = []
                     for lane in PRIORITIES:
                         if overflow:
                             break
-                        for r in lanes[lane]:
-                            if batch and total + r.rows > state.cap:
-                                overflow = True
-                                break
-                            r.launched = True  # past dedup sharing window
-                            batch.append(r)
-                            total += r.rows
+                        queues = lanes[lane]
+                        if not queues:
+                            continue
+                        if len(queues) == 1:
+                            (reqs,) = queues.values()
+                            for r in reqs:
+                                if batch and total + r.rows > state.cap:
+                                    overflow = True
+                                    break
+                                r.launched = True  # past dedup sharing
+                                batch.append(r)
+                                total += r.rows
+                            continue
+                        total, overflow = self._drr_release_locked(
+                            state, queues, batch, total, throttled)
+                    if throttled and batch:
+                        for tenant in sorted(set(throttled)):
+                            health.record(
+                                health.TENANT_THROTTLED, tenant=tenant,
+                                released_rows=total)
                     if batch or expired:
                         dropped = {id(r) for r in batch}
                         dropped.update(id(r) for r in expired)
@@ -1106,13 +1179,62 @@ class DeviceExecutor:
                                        "down with this request still "
                                        "queued"))
 
+    def _drr_release_locked(self, state: _FnState,
+                            queues: Dict[str, List[_Request]],
+                            batch: List[_Request], total: int,
+                            throttled: List[str]) -> Tuple[int, bool]:
+        """Release one lane's queued requests by deficit-round-robin
+        (caller holds ``state.cond``). Each round credits every tenant
+        ``weight * quantum`` rows (quantum = the largest head-of-line
+        request, so every tenant frees at least its head per round — the
+        loop is O(requests) releases, never stuck), then releases that
+        tenant's FIFO while the credit covers it. The first over-cap
+        head stops the whole drain (same overflow contract as the FIFO
+        path); credit persists across drains for tenants left queued —
+        that deficit IS the fairness memory — and resets once a tenant
+        drains dry, so idle tenants never bank unbounded credit.
+        Tenants left holding requests while the batch launched are
+        appended to ``throttled``. Returns ``(total, overflow)``."""
+        weights = state.tenant_weights or {}
+        deficit = state.tenant_deficit
+        order = sorted(queues)
+        overflow = False
+        while not overflow and any(queues[t] for t in order):
+            quantum = max(float(queues[t][0].rows)
+                          for t in order if queues[t])
+            for tenant in order:
+                fifo = queues[tenant]
+                if not fifo:
+                    continue
+                deficit[tenant] = (deficit.get(tenant, 0.0)
+                                   + max(1, weights.get(tenant, 1))
+                                   * quantum)
+                while fifo and deficit[tenant] >= fifo[0].rows:
+                    r = fifo[0]
+                    if batch and total + r.rows > state.cap:
+                        overflow = True
+                        break
+                    fifo.pop(0)
+                    deficit[tenant] -= r.rows
+                    r.launched = True  # past the dedup sharing window
+                    batch.append(r)
+                    total += r.rows
+                if overflow:
+                    break
+        for tenant in order:
+            if not queues[tenant]:
+                deficit.pop(tenant, None)
+            else:
+                throttled.append(tenant)
+        return total, overflow
+
     def _fail_expired(self, expired: List[_Request]) -> None:
         """Deliver the deadline-shed outcome: the same deadline-marked
         taxonomy the supervisor's watchdog uses (``DeadlineExceeded`` →
         FATAL, never retried past the budget, never quarantined)."""
         for r in expired:
             health.record(health.EXECUTOR_DEADLINE_SHED, rows=r.rows,
-                          priority=r.priority,
+                          priority=r.priority, tenant=r.tenant,
                           queued_s=round(time.monotonic() - r.t_enqueue, 4))
             if not r.future.done():
                 r.future.set_exception(resilience.DeadlineExceeded(
@@ -1153,6 +1275,15 @@ class DeviceExecutor:
             # that waited, not the coalescer thread's ambient context
             telemetry.observe(telemetry.M_QUEUE_WAIT_S, now - r.t_enqueue,
                               exemplar=r.ctx)
+            if r.tenant != DEFAULT_TENANT:
+                # per-tenant fairness series (per-tenant NAMES — metrics
+                # carry no labels); the default tenant stays on the
+                # aggregate only, so single-tenant jobs add no series
+                telemetry.observe(
+                    telemetry.declare_metric(
+                        telemetry.tenant_queue_wait_metric(r.tenant),
+                        "histogram"),
+                    now - r.t_enqueue, exemplar=r.ctx)
         groups: Dict[Tuple, List[_Request]] = {}
         for r in batch:
             groups.setdefault(batching.element_signature(r.tree),
@@ -1362,6 +1493,7 @@ def execute(model: Any, array: Any, *, batch_size: int = 64,
             prefetch: int = 2, coalesce: Optional[bool] = None,
             priority: Optional[str] = None,
             deadline: Optional[resilience.Deadline] = None,
+            tenant: Optional[str] = None,
             coalesce_window_ms: Optional[float] = None) -> Any:
     """THE device entry point for the inference data plane.
 
@@ -1377,8 +1509,12 @@ def execute(model: Any, array: Any, *, batch_size: int = 64,
     ``EngineConfig.executor_default_priority``) picks the service lane;
     ``deadline`` (``None`` adopts the ambient :class:`deadline_scope`
     one, which the engine supervisor threads per task) bounds queue wait
-    and backpressure blocking. The admission/breaker knobs are read from
-    ``EngineConfig`` per call — see the module docstring.
+    and backpressure blocking. ``tenant`` tags the request for the
+    fair-queueing coalescer (``None`` adopts the ambient
+    :class:`tenant_scope` tag, falling back to
+    ``EngineConfig.executor_default_tenant``). The admission/breaker
+    knobs are read from ``EngineConfig`` per call — see the module
+    docstring.
 
     ``coalesce_window_ms`` overrides ``EngineConfig.coalesce_window_ms``
     for THIS call: the serving plane's per-model SLO targets drive the
@@ -1446,8 +1582,14 @@ def execute(model: Any, array: Any, *, batch_size: int = 64,
         priority = EngineConfig.executor_default_priority
     if deadline is None:
         deadline = current_deadline()
+    if tenant is None:
+        tenant = current_tenant()
+        if tenant is None:
+            tenant = EngineConfig.executor_default_tenant
     return _service.submit(model, array, rows, batch_size, mesh, multiple,
                            policy, window_s, cap, prefetch,
                            priority=priority, deadline=deadline,
+                           tenant=tenant,
+                           tenant_weights=EngineConfig.executor_tenant_weights,
                            overload=overload, donate=donate,
                            planner=planner)

@@ -95,6 +95,23 @@ CLUSTER_WORKER_LOST = "cluster_worker_lost"  # cluster: a worker died
 CLUSTER_REDISPATCH = "cluster_redispatch"  # cluster: a dead worker's
                                          # in-flight partition re-sent
                                          # to a survivor
+CLUSTER_SCALE_UP = "cluster_scale_up"    # cluster: autoscaler spawned a
+                                         # worker under queue pressure
+CLUSTER_SCALE_DOWN = "cluster_scale_down"  # cluster: autoscaler retired
+                                         # an idle worker via drain
+CLUSTER_WORKER_DRAINING = "cluster_worker_draining"  # cluster: a worker
+                                         # stopped taking dispatches
+                                         # (preemption warning or
+                                         # scale-down order)
+CLUSTER_WORKER_DRAINED = "cluster_worker_drained"  # cluster: a draining
+                                         # worker finished its in-flight
+                                         # tasks and exited cleanly
+CLUSTER_PREEMPTION_NOTICE = "cluster_preemption_notice"  # cluster: a
+                                         # worker reported SIGTERM-with-
+                                         # warning (spot-VM preemption)
+TENANT_THROTTLED = "tenant_throttled"    # executor: fair queueing held a
+                                         # tenant's requests back while
+                                         # another tenant's were released
 
 
 class HealthMonitor:

@@ -131,6 +131,29 @@ class ClusterWorkerLost(RuntimeError):
     source without an import cycle."""
 
 
+class WorkerDraining(RuntimeError):
+    """A task was routed to (or refused by) a cluster worker that is
+    draining: it received a preemption warning or a scale-down order and
+    accepts no new dispatches while its in-flight tasks finish
+    (``sparkdl_tpu/cluster/router.py``). RETRYABLE by definition: the
+    work itself is untouched — another worker (or a freshly spawned
+    replacement) can run it immediately, and journal-committed
+    partitions never re-execute. Defined here (not in the cluster
+    package) so :func:`classify` stays the single taxonomy source
+    without an import cycle."""
+
+
+class DrainTimeout(RuntimeError):
+    """A draining cluster worker failed to finish its in-flight tasks
+    before the drain grace period expired (the preemptor's warning
+    window, ``sparkdl_tpu/cluster/router.py``) and was torn down hard.
+    RETRYABLE by definition: the interrupted tasks are indistinguishable
+    from worker loss — the router re-dispatches them to survivors, and
+    journal-committed partitions stay committed. Defined here so
+    :func:`classify` stays the single taxonomy source without an import
+    cycle."""
+
+
 class StaleCheckpointWriter(RuntimeError):
     """A checkpoint save was refused by the fencing token: this process
     belongs to a superseded gang incarnation and a newer writer has
@@ -193,7 +216,7 @@ def classify(err: BaseException) -> str:
         return OOM
     if isinstance(err, (Preemption, TransferStall, ExecutorOverloaded,
                         ExecutorCircuitOpen, DecodeWorkerLost,
-                        ClusterWorkerLost)):
+                        ClusterWorkerLost, WorkerDraining, DrainTimeout)):
         return RETRYABLE
     if isinstance(err, DeadlineExceeded):
         return FATAL  # the deadline IS the retry budget; never retry past it
@@ -369,6 +392,14 @@ INJECTION_POINTS: Dict[str, Tuple[str, Optional[Callable[[], BaseException]]]] =
         "EOF death detection, precise re-dispatch of the dead worker's "
         "in-flight partitions to survivors, and the merged-report "
         "accounting for a lost worker", None),
+    "cluster_worker_preempt": (
+        "behavioral: the cluster router marks the next dispatched "
+        "partition so its worker process SIGTERMs itself on receipt — "
+        "a spot-VM preemption WARNING, not a kill: the worker still "
+        "runs the task, notifies the router it is draining, and exits "
+        "cleanly once drained (sparkdl_tpu/cluster/); ctx carries "
+        "partition — exercises graceful drain with zero re-execution "
+        "instead of the ClusterWorkerLost re-dispatch path", None),
 }
 
 

@@ -352,3 +352,28 @@ def default_serving_rules(model_targets: Optional[Dict[str, float]] = None,
                     window_s=window_s, threshold=float(target_s),
                     comparator=">", stat="p99", for_s=for_s))
     return tuple(rules)
+
+
+def tenant_queue_wait_rules(tenant_targets: Dict[str, float],
+                            window_s: float = DEFAULT_WINDOW_S,
+                            for_s: float = DEFAULT_HOLD_S,
+                            ) -> Tuple[SLORule, ...]:
+    """One queue-wait p99 rule per entry of ``tenant_targets`` (tenant
+    tag -> p99 target in SECONDS) — the fairness objective of the
+    elastic-capacity plane: under sustained overload from one tenant,
+    the OTHER tenants' queue-wait p99 staying under target is what
+    proves deficit-round-robin is doing its job. Per-tenant metrics have
+    per-tenant names (``sparkdl.executor.queue_wait_s.<tenant>``,
+    emitted by ``core/executor.py`` for every non-default tenant), so
+    each rule watches its tenant's own series — declared here via
+    :func:`telemetry.declare_metric`, same dynamic-name pattern as the
+    per-model serving rules above."""
+    rules = []
+    for tenant, target_s in sorted(tenant_targets.items()):
+        metric = telemetry.declare_metric(
+            telemetry.tenant_queue_wait_metric(tenant), "histogram")
+        rules.append(
+            SLORule(f"tenant_queue_wait_p99_{tenant}", metric=metric,
+                    window_s=window_s, threshold=float(target_s),
+                    comparator=">", stat="p99", for_s=for_s))
+    return tuple(rules)

@@ -196,6 +196,16 @@ M_CLUSTER_DISPATCH_S = "sparkdl.cluster.dispatch_s"    # histogram (per
                                                        # partition round
                                                        # trip)
 M_CLUSTER_REDISPATCH = "sparkdl.cluster.redispatch"    # counter
+# Elastic capacity (autoscaler + graceful drain, docs/DISTRIBUTED.md
+# "Elastic capacity"): the live worker-set size and how long a drain
+# takes from preemption notice / scale-down order to clean exit.
+M_CLUSTER_WORKERS = "sparkdl.cluster.workers"          # gauge (live,
+                                                       # non-draining)
+M_CLUSTER_DRAIN_S = "sparkdl.cluster.drain_s"          # histogram
+# Per-tenant fair queueing (core/executor.py, docs/RESILIENCE.md): each
+# tenant's queue-wait histogram gets a per-tenant NAME (metrics carry no
+# labels), declared dynamically as "sparkdl.executor.queue_wait_s.<tenant>"
+# via tenant_queue_wait_metric() + declare_metric().
 HEALTH_METRIC_PREFIX = "sparkdl.health."
 
 # Instrument kind per canonical metric — machine-readable so core/slo.py
@@ -234,6 +244,8 @@ CANONICAL_METRIC_KINDS: Dict[str, str] = {
     M_CLUSTER_OUTSTANDING_ROWS: "gauge",
     M_CLUSTER_DISPATCH_S: "histogram",
     M_CLUSTER_REDISPATCH: "counter",
+    M_CLUSTER_WORKERS: "gauge",
+    M_CLUSTER_DRAIN_S: "histogram",
 }
 
 CANONICAL_METRIC_NAMES = frozenset(CANONICAL_METRIC_KINDS)
@@ -272,6 +284,16 @@ def serving_request_metric(model: str) -> str:
     at deploy time (``declare_metric``), observed by the ModelServer
     beside the aggregate ``M_SERVING_REQUEST_S``."""
     return M_SERVING_REQUEST_S + "." + model
+
+
+def tenant_queue_wait_metric(tenant: str) -> str:
+    """The per-tenant queue-wait histogram name. Like the per-model
+    serving latency, per-tenant fairness objectives get per-tenant NAMES
+    — declared on first use (``declare_metric``) by the executor's
+    coalescer, observed beside the aggregate ``M_QUEUE_WAIT_S`` so a
+    flooding tenant's self-inflicted wait is distinguishable from the
+    wait it imposes on everyone else."""
+    return M_QUEUE_WAIT_S + "." + tenant
 
 # ---------------------------------------------------------------------------
 # Span tracing

@@ -167,6 +167,42 @@ class EngineConfig:
     # on coordinator memory for shipped-but-unconsumed partitions);
     # None = 2 * cluster_workers.
     cluster_inflight_partitions: Optional[int] = None
+    # -- elastic capacity (cluster autoscaler + graceful drain,
+    # docs/DISTRIBUTED.md "Elastic capacity") ----------------------------------
+    # Arm the router's autoscaler: grow/shrink the live worker set
+    # between cluster_min_workers and cluster_max_workers from windowed
+    # queue-wait p99 and outstanding rows per worker. False (default)
+    # keeps the worker set exactly cluster_workers — byte-identical to
+    # before the knob existed. Always forced off INSIDE workers.
+    cluster_autoscale: bool = False
+    cluster_min_workers: int = 1
+    cluster_max_workers: int = 8
+    # Telemetry window the scaling signals are computed over, and the
+    # minimum quiet period between two scaling actions (cooldown — paired
+    # with the high/low hysteresis gap below so the set never flaps).
+    autoscale_window_s: float = 5.0
+    autoscale_cooldown_s: float = 5.0
+    # Scale UP when windowed queue-wait p99 exceeds the high-water mark
+    # (or rows-in-flight per worker exceed theirs); scale DOWN only when
+    # p99 is below the much lower low-water mark AND a worker sits idle.
+    autoscale_queue_wait_high_s: float = 0.5
+    autoscale_queue_wait_low_s: float = 0.05
+    autoscale_rows_per_worker_high: int = 4096
+    # -- per-tenant fair queueing (core/executor.py, docs/RESILIENCE.md
+    # "Tenant fairness") --------------------------------------------------------
+    # Relative deficit-round-robin weights per tenant tag; tenants absent
+    # from the dict (and all tenants when None) get weight 1. A tenant
+    # with weight 2 drains twice the rows per round of a weight-1 tenant
+    # when both have queued work — a flooding tenant saturates only its
+    # share.
+    executor_tenant_weights: Optional[Dict[str, int]] = None
+    # Tenant tag assigned to requests that don't carry one (explicit
+    # execute(tenant=...) > ambient executor.tenant_scope > this).
+    executor_default_tenant: str = "default"
+    # Tenant tag stamped on this job's PARTITION dispatches (engine
+    # materialize/stream through the cluster router); None leaves
+    # partition work on the default tenant.
+    job_tenant: Optional[str] = None
     max_workers: int = max(2, (os.cpu_count() or 4) // 2)
     # DEPRECATED test hook (SURVEY.md §5.3 fault injection):
     # callable(partition_index, attempt) that may raise to simulate a task
@@ -221,8 +257,16 @@ class EngineConfig:
                  cls.executor_breaker_cooldown_s,
                  cls.executor_idle_retire_s, cls.decode_workers,
                  cls.decode_pool_inflight, cls.cluster_workers,
-                 cls.cluster_inflight_partitions, cls.durable_dir,
-                 cls.max_workers)
+                 cls.cluster_inflight_partitions, cls.cluster_autoscale,
+                 cls.cluster_min_workers, cls.cluster_max_workers,
+                 cls.autoscale_window_s, cls.autoscale_cooldown_s,
+                 cls.autoscale_queue_wait_high_s,
+                 cls.autoscale_queue_wait_low_s,
+                 cls.autoscale_rows_per_worker_high,
+                 (None if cls.executor_tenant_weights is None
+                  else tuple(sorted(cls.executor_tenant_weights.items()))),
+                 cls.executor_default_tenant, cls.job_tenant,
+                 cls.durable_dir, cls.max_workers)
         if knobs == cls._validated_knobs:
             return
 
@@ -304,6 +348,63 @@ class EngineConfig:
                 f"the cluster plane), got {cls.cluster_workers!r}")
         positive("cluster_inflight_partitions",
                  cls.cluster_inflight_partitions)
+        if not isinstance(cls.cluster_autoscale, bool):
+            raise ValueError(
+                "EngineConfig.cluster_autoscale must be a bool, got "
+                f"{cls.cluster_autoscale!r}")
+        if cls.cluster_min_workers < 1:
+            raise ValueError(
+                "EngineConfig.cluster_min_workers must be >= 1, got "
+                f"{cls.cluster_min_workers!r}")
+        if cls.cluster_max_workers < cls.cluster_min_workers:
+            raise ValueError(
+                "EngineConfig.cluster_max_workers must be >= "
+                f"cluster_min_workers ({cls.cluster_min_workers}), got "
+                f"{cls.cluster_max_workers!r}")
+        positive("autoscale_window_s", cls.autoscale_window_s,
+                 allow_none=False)
+        positive("autoscale_cooldown_s", cls.autoscale_cooldown_s,
+                 allow_none=False, exclusive=False)
+        positive("autoscale_queue_wait_high_s",
+                 cls.autoscale_queue_wait_high_s, allow_none=False)
+        positive("autoscale_queue_wait_low_s",
+                 cls.autoscale_queue_wait_low_s, allow_none=False)
+        if cls.autoscale_queue_wait_low_s >= cls.autoscale_queue_wait_high_s:
+            raise ValueError(
+                "EngineConfig.autoscale_queue_wait_low_s must be < "
+                "autoscale_queue_wait_high_s "
+                f"({cls.autoscale_queue_wait_high_s}), got "
+                f"{cls.autoscale_queue_wait_low_s!r} — the hysteresis "
+                "gap is what keeps the worker set from flapping")
+        if cls.autoscale_rows_per_worker_high < 1:
+            raise ValueError(
+                "EngineConfig.autoscale_rows_per_worker_high must be "
+                f">= 1, got {cls.autoscale_rows_per_worker_high!r}")
+        if cls.executor_tenant_weights is not None:
+            if not isinstance(cls.executor_tenant_weights, dict):
+                raise ValueError(
+                    "EngineConfig.executor_tenant_weights must be None "
+                    "or a dict of tenant -> positive int weight, got "
+                    f"{cls.executor_tenant_weights!r}")
+            for t, w in cls.executor_tenant_weights.items():
+                if not isinstance(t, str) or not t:
+                    raise ValueError(
+                        "EngineConfig.executor_tenant_weights keys must "
+                        f"be non-empty tenant strings, got {t!r}")
+                if not isinstance(w, int) or isinstance(w, bool) or w < 1:
+                    raise ValueError(
+                        "EngineConfig.executor_tenant_weights values "
+                        f"must be positive ints, got {t!r}={w!r}")
+        if (not isinstance(cls.executor_default_tenant, str)
+                or not cls.executor_default_tenant):
+            raise ValueError(
+                "EngineConfig.executor_default_tenant must be a "
+                f"non-empty string, got {cls.executor_default_tenant!r}")
+        if cls.job_tenant is not None and (
+                not isinstance(cls.job_tenant, str) or not cls.job_tenant):
+            raise ValueError(
+                "EngineConfig.job_tenant must be None or a non-empty "
+                f"tenant string, got {cls.job_tenant!r}")
         if cls.durable_dir is not None and (
                 not isinstance(cls.durable_dir, str) or not cls.durable_dir):
             raise ValueError(

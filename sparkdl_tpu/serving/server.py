@@ -111,16 +111,22 @@ class ModelServer:
 
     def predict(self, model: str, rows: Any, *,
                 deadline_ms: Optional[float] = None,
-                priority: str = executor.PRIORITY_INTERACTIVE
+                priority: str = executor.PRIORITY_INTERACTIVE,
+                tenant: Optional[str] = None
                 ) -> PredictResult:
         """Serve one row (rank = the model's element rank; the batch
         dim is added and squeezed back) or one small batch. Rides the
         interactive lane unless told otherwise; ``deadline_ms`` bounds
         queue wait, backpressure blocking and drain (the executor drops
-        an expired request unlaunched)."""
+        an expired request unlaunched). ``tenant`` is the fair-queueing
+        tag: requests from different tenants share the executor under
+        deficit-round-robin, and non-default tenants get their own
+        queue-wait series + shed attribution (None resolves through the
+        ambient ``executor.tenant_scope`` / EngineConfig default)."""
         t0 = time.monotonic()
         active, shadow = self.registry.resolve(model)
-        self._admit(active)  # shed BEFORE paying for staging / cold load
+        # shed BEFORE paying for staging / cold load
+        self._admit(active, tenant=tenant)
         batch, single = self._stage_rows(active, rows)
         deadline = (resilience.Deadline(deadline_ms / 1e3)
                     if deadline_ms is not None else None)
@@ -130,7 +136,7 @@ class ModelServer:
             out = executor.execute(
                 active.model(), batch, batch_size=active.batch_size,
                 priority=priority, deadline=deadline,
-                coalesce_window_ms=window_ms)
+                coalesce_window_ms=window_ms, tenant=tenant)
         finally:
             self._note_inflight(-1)
         shadowed = False
@@ -140,7 +146,8 @@ class ModelServer:
             # explicitly: shadow work stays attributable to THIS request
             # even if the lane ever moves off the caller thread
             self._run_shadow(model, active, shadow, batch, out, active_s,
-                             window_ms, ctx=telemetry.current_context())
+                             window_ms, ctx=telemetry.current_context(),
+                             tenant=tenant)
             shadowed = True
         latency_s = time.monotonic() - t0
         if telemetry.active() is not None:
@@ -156,7 +163,7 @@ class ModelServer:
 
     # -- SLO-aware admission -------------------------------------------------
 
-    def _admit(self, dep: Any) -> None:
+    def _admit(self, dep: Any, tenant: Optional[str] = None) -> None:
         target_s = dep.latency_target_s
         if target_s is None or self._admission != "shed":
             return  # block mode: executor backpressure + deadline bound it
@@ -170,7 +177,9 @@ class ModelServer:
         if p99 is not None and p99 > budget_s:
             health.record(health.SERVING_SHED, model=dep.name,
                           version=dep.version, queue_wait_p99_s=p99,
-                          budget_s=budget_s)
+                          budget_s=budget_s,
+                          tenant=tenant or executor.current_tenant()
+                          or executor.DEFAULT_TENANT)
             raise ServingOverloaded(
                 f"model {dep.name!r}: windowed queue-wait p99 "
                 f"{p99:.4f}s exceeds the {budget_s:.4f}s queue budget "
@@ -187,7 +196,8 @@ class ModelServer:
     def _run_shadow(self, name: str, active: Any, shadow: Any,
                     batch: Any, active_out: Any, active_s: float,
                     window_ms: Optional[float],
-                    ctx: Optional[telemetry.SpanContext] = None) -> None:
+                    ctx: Optional[telemetry.SpanContext] = None,
+                    tenant: Optional[str] = None) -> None:
         """Mirror ONE request to the shadow version: run it on the BULK
         lane (a candidate must never crowd live traffic), compare
         outputs element-wise, record divergence + both latencies. A
@@ -195,7 +205,9 @@ class ModelServer:
         swallowed — the client already has its answer from the active
         version. The shadow leg runs under its own
         ``sparkdl.serving_shadow`` span parented on the request context
-        ``ctx``."""
+        ``ctx``, and carries the request's ``tenant`` tag so
+        candidate-version work burns the requesting tenant's
+        fair-queueing quota, not another tenant's."""
         t0 = time.monotonic()
         try:
             with telemetry.span(telemetry.SPAN_SERVING_SHADOW,
@@ -204,7 +216,7 @@ class ModelServer:
                 shadow_out = executor.execute(
                     shadow.model(), batch, batch_size=shadow.batch_size,
                     priority=executor.PRIORITY_BULK,
-                    coalesce_window_ms=window_ms)
+                    coalesce_window_ms=window_ms, tenant=tenant)
         except Exception as e:  # noqa: BLE001 - recorded, never re-raised
             health.record(health.SERVING_SHADOW_ERROR, model=name,
                           active_version=active.version,

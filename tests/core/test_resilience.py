@@ -17,11 +17,13 @@ from sparkdl_tpu.core.resilience import (
     Deadline,
     DeadlineExceeded,
     DeviceOOM,
+    DrainTimeout,
     Fault,
     FaultInjector,
     Preemption,
     RetryPolicy,
     TransferStall,
+    WorkerDraining,
     classify,
 )
 
@@ -39,6 +41,11 @@ from sparkdl_tpu.core.resilience import (
     (RuntimeError("Resource exhausted: HBM"), OOM),
     (Preemption(), RETRYABLE),
     (TransferStall(), RETRYABLE),
+    # the elastic-capacity drain classes: both transient by design —
+    # a drained-away dispatch re-routes to a live worker; a torn-down
+    # drain takes the ordinary lost-worker re-dispatch path
+    (WorkerDraining("all candidates draining"), RETRYABLE),
+    (DrainTimeout("exceeded the 60s drain grace"), RETRYABLE),
     (RuntimeError("UNAVAILABLE: socket closed"), RETRYABLE),
     (RuntimeError("something unprecedented"), RETRYABLE),  # gang default
     (OSError("connection reset"), RETRYABLE),
@@ -72,6 +79,43 @@ def test_retry_policy_deterministic_and_exponential():
     assert [p.delay(i) for i in (1, 2, 3, 4)] == [1.0, 2.0, 4.0, 5.0]
     with pytest.raises(ValueError):
         p.delay(0)
+
+
+def test_retry_policy_backoff_and_jitter_bounds():
+    """Every delay lands in [ideal, ideal * (1 + jitter)] where ideal is
+    the capped exponential — jitter only ever ADDS (never shortens a
+    backoff below the schedule), and the cap bounds the worst case at
+    max_delay_s * (1 + jitter)."""
+    for seed in (0, 1, 7, 1234):
+        p = RetryPolicy(base_delay_s=0.25, multiplier=3.0, jitter=0.4,
+                        max_delay_s=2.0, seed=seed)
+        for attempt in range(1, 9):
+            ideal = min(0.25 * 3.0 ** (attempt - 1), 2.0)
+            d = p.delay(attempt)
+            assert ideal <= d <= ideal * 1.4 + 1e-12, (seed, attempt, d)
+    with pytest.raises(ValueError):
+        RetryPolicy().delay(-1)
+
+
+def test_retry_policy_execute_retries_drain_classes():
+    """WorkerDraining / DrainTimeout behave as transients end to end: a
+    fake clock proves the retry loop consumed the classified-RETRYABLE
+    path (backoff slept) rather than re-raising."""
+    slept = []
+    calls = []
+
+    def raced():
+        calls.append(1)
+        if len(calls) == 1:
+            raise WorkerDraining("routed to a draining worker")
+        if len(calls) == 2:
+            raise DrainTimeout("drain grace exceeded")
+        return "ok"
+
+    policy = RetryPolicy(max_retries=3, base_delay_s=0.5, jitter=0.0)
+    assert policy.execute(raced, sleep=slept.append) == "ok"
+    assert len(calls) == 3
+    assert slept == [0.5, 1.0]  # one backoff per transient, no jitter
 
 
 def test_retry_policy_execute_retries_transient_only():

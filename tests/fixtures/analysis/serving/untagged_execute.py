@@ -1,0 +1,11 @@
+"""Seeded violation: a serving-plane request entering the executor
+without a tenant tag (tenant-tag; the `serving/` path segment puts this
+in scope — an untagged online request burns the shared default lane's
+deficit-round-robin quota, so one client's flood starves every other
+untagged client with no per-tenant series to show it)."""
+
+from sparkdl_tpu.core import executor
+
+
+def predict_row(model, batch):
+    return executor.execute(model, batch, batch_size=1)

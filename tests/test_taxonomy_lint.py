@@ -270,3 +270,62 @@ def test_slo_metric_lint_catches_typos_and_resolves_constants():
         _telemetry.CANONICAL_METRIC_NAMES
     assert "executor_shed" in {
         getattr(_health, name) for name in lints.HEALTH_EVENT_CONSTANTS}
+
+
+# ---------------------------------------------------------------------------
+# tenant-tag (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+
+def test_serving_plane_always_tags_executor_calls():
+    # the rule is not vacuous: the serving plane really calls
+    # executor.execute (the predict path and the shadow leg)
+    server_tree = ast.parse(
+        (ROOT / "serving" / "server.py").read_text())
+    assert len(lints.untagged_execute_calls(server_tree)) == 0
+    calls = [n for n in ast.walk(server_tree)
+             if isinstance(n, ast.Call)
+             and isinstance(n.func, ast.Attribute)
+             and n.func.attr == "execute"]
+    assert len(calls) >= 2, "serving plane stopped calling the executor?"
+    offenders = _package_findings("tenant-tag")
+    assert not offenders, (
+        "serving-plane executor.execute() without a tenant= argument — "
+        "the request burns the shared default lane's deficit-round-robin "
+        "quota and vanishes from the per-tenant queue-wait series. "
+        f"Thread the caller's tenant tag: {[str(f) for f in offenders]}")
+
+
+def test_tenant_tag_lint_catches_untagged_serving_calls():
+    """Self-test: an untagged serving-plane execute trips; an explicit
+    tag — even ``tenant=None`` — passes, a ``**kwargs`` spread is not
+    statically checkable and passes, and the batch route (ml/) stays
+    out of scope by path."""
+    bad = (
+        "from sparkdl_tpu.core import executor\n"
+        "def predict(model, batch, kw):\n"
+        "    a = executor.execute(model, batch, batch_size=1)\n"  # bad
+        "    b = execute(model, batch, batch_size=1)\n"           # bad
+        "    c = executor.execute(model, batch, tenant='acme')\n"  # ok
+        "    d = executor.execute(model, batch, tenant=None)\n"    # ok
+        "    e = executor.execute(model, batch, **kw)\n"           # spread
+        "    return a, b, c, d, e\n"
+    )
+    assert _seed("tenant-tag", bad, rel="serving/seed.py") == [3, 4]
+    # the batch/featurize route resolves its tenant ambiently — out of
+    # scope by path, same scoping mechanism as executor-choke-point
+    assert _seed("tenant-tag", bad, rel="ml/seed.py") == []
+
+
+def test_tenant_tag_suppression_works():
+    bad = (
+        "from sparkdl_tpu.core import executor\n"
+        "def probe(model, batch):\n"
+        "    return executor.execute(model, batch)"
+        "  # sparkdl: allow(tenant-tag): synthetic warmup probe, "
+        "not client traffic\n"
+    )
+    src = framework.SourceFile.from_source(bad, rel="serving/seed.py")
+    res = analysis.analyze_sources([src], rule_ids=["tenant-tag"])
+    assert not res.findings
+    assert len(res.suppressed) == 1
