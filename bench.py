@@ -683,6 +683,116 @@ def bench_serving(name="EfficientNetB0", n_interactive=64,
     }
 
 
+def bench_serving_failover(name="EfficientNetB0", size=(224, 224),
+                           n_steady=32, n_chaos=32, n_swap=24,
+                           workers=2, deadline_ms=120_000.0):
+    """ISSUE 17 leg: the cluster serving plane under replica death and
+    a live hot swap (docs/SERVING.md "Cluster serving").
+
+    One deployment replicated across ``workers`` cluster processes.
+    Three phases on the same stack, ONE record: (a) steady-state
+    request p99; (b) SIGKILL one of the replicas mid-stream — every
+    request must still complete inside its deadline via failover, and
+    the record carries the failover-phase p99 beside the steady p99
+    plus the exactly-once ``serving_failover`` count; (c) a
+    cluster-atomic hot swap under a single-threaded request stream —
+    because the caller is sequential, responses are strictly ordered,
+    so ``cutover_mix_window_ms`` (how long v1 completions kept landing
+    after the first v2 completion) is race-free and MUST be 0."""
+    import os
+    import signal
+    import threading
+
+    from sparkdl_tpu.cluster import router as cluster_router
+    from sparkdl_tpu.core import executor as device_executor
+    from sparkdl_tpu.core import health
+    from sparkdl_tpu.core.health import HealthMonitor
+    from sparkdl_tpu.engine.dataframe import EngineConfig
+    from sparkdl_tpu.models import registry as model_registry
+    from sparkdl_tpu.serving import ModelRegistry, ModelServer
+
+    rng = np.random.default_rng(0)
+    requests = rng.normal(
+        size=(max(n_steady, n_chaos, n_swap),) + size + (3,)) \
+        .astype(np.float32)
+
+    saved = EngineConfig.snapshot()
+    try:
+        device_executor.reset()
+        EngineConfig.cluster_workers = workers
+        EngineConfig.serving_cluster = True
+        reg = ModelRegistry()
+        srv = ModelServer(reg)
+        reg.deploy("featurizer", "v1",
+                   model=model_registry.build_featurizer(
+                       name, weights="random"),
+                   batch_size=HEADLINE_BATCH)
+        reg.deploy("featurizer", "v2",
+                   model=model_registry.build_featurizer(
+                       name, weights="random"),
+                   batch_size=HEADLINE_BATCH)
+        srv.predict("featurizer", requests[0],
+                    deadline_ms=deadline_ms)  # compile + warm a replica
+
+        def stream(n, log):
+            for i in range(n):
+                got = srv.predict("featurizer", requests[i],
+                                  deadline_ms=deadline_ms)
+                log.append((time.perf_counter(), got.latency_s,
+                            got.version))
+
+        steady = []
+        stream(n_steady, steady)
+
+        # chaos: kill -9 the hot replica a few requests into the stream
+        router = cluster_router.maybe_router()
+        replicas = srv.status()["cluster"]["featurizer"]["replicas"]
+        hot_name = next(w for w, v in replicas.items() if v["resident"])
+        hot = next(w for w in router._workers
+                   if w.proc.name == hot_name and w.proc.is_alive())
+        chaos = []
+        with HealthMonitor("serving-failover") as mon:
+            killer = threading.Timer(
+                0.0, lambda: os.kill(hot.proc.pid, signal.SIGKILL))
+            killer.start()
+            stream(n_chaos, chaos)
+            killer.join()
+        moved = len(mon.events(health.SERVING_FAILOVER))
+
+        # hot swap under a sequential stream: fire the cutover from a
+        # side thread while the caller keeps requesting
+        swap_log = []
+        cut = threading.Timer(
+            0.0, lambda: srv.cutover("featurizer", "v2"))
+        cut.start()
+        stream(n_swap, swap_log)
+        cut.join()
+        v1_ends = [t for t, _, v in swap_log if v == "v1"]
+        v2_ends = [t for t, _, v in swap_log if v == "v2"]
+        mix_window_ms = (
+            max(0.0, (max(v1_ends) - min(v2_ends)) * 1e3)
+            if v1_ends and v2_ends else 0.0)
+    finally:
+        cluster_router.shutdown()
+        EngineConfig.restore(saved)
+        device_executor.reset()
+
+    def p(lats, q):
+        return round(float(np.percentile(
+            [l for _, l, _ in lats], q)) * 1e3, 3)
+
+    return {
+        "steady_p50_ms": p(steady, 50),
+        "steady_p99_ms": p(steady, 99),
+        "failover_p50_ms": p(chaos, 50),
+        "failover_p99_ms": p(chaos, 99),
+        "answered_under_kill": len(chaos),
+        "moved_requests": moved,
+        "cutover_mix_window_ms": round(mix_window_ms, 3),
+        "swap_versions_served": sorted({v for _, _, v in swap_log}),
+    }
+
+
 def bench_exporter_overhead(name="EfficientNetB0", n_images=128,
                             partitions=8, size=(224, 224)):
     """ISSUE 7 satellite: the periodic snapshot exporter's cost on a
@@ -1454,6 +1564,19 @@ def main():
                  cold_start_s=sv["cold_start_s"],
                  cold_start_bytes=sv["cold_start_bytes"],
                  request_s=sv["request_s"], elapsed_s=sv["elapsed_s"])
+            # cluster serving failover (ISSUE 17): SIGKILL one of two
+            # replicas mid-stream — failover-phase p99 beside steady
+            # p99, and the hot-swap mix window, which must be 0
+            fo = bench_serving_failover()
+            emit("serving failover p99 ms (EfficientNetB0, kill 1-of-2 "
+                 "replicas mid-stream)", fo["failover_p99_ms"],
+                 "ms/step", steady_p99_ms=fo["steady_p99_ms"],
+                 steady_p50_ms=fo["steady_p50_ms"],
+                 failover_p50_ms=fo["failover_p50_ms"],
+                 answered_under_kill=fo["answered_under_kill"],
+                 moved_requests=fo["moved_requests"],
+                 cutover_mix_window_ms=fo["cutover_mix_window_ms"],
+                 swap_versions_served=fo["swap_versions_served"])
             # live observability plane (ISSUE 7): the periodic exporter's
             # cost must stay under 5% — measured on the same featurize
             # loop with the exporter on vs off

@@ -561,22 +561,35 @@ class AtomicWriteRule(Rule):
 TENANT_SCOPES = ("serving",)
 
 
+#: Serving-plane dispatch entry points the tenant tag must ride
+#: through. ``execute`` is the single-process choke point;
+#: ``submit_predict`` is the cluster serving router's wire dispatch
+#: (serving/cluster.py) — a routed predict that drops the tag would
+#: burn the default lane's quota on the WORKER, invisibly to the
+#: coordinator's per-tenant series.
+_TENANT_DISPATCH_NAMES = ("execute", "submit_predict")
+
+
 def untagged_execute_calls(tree: ast.AST) -> List[int]:
-    """Lines of ``executor.execute(...)`` (or bare ``execute(...)``)
-    calls with neither a ``tenant=`` keyword nor a ``**kwargs`` spread
-    (a spread may carry the tag; it is not statically checkable and is
-    skipped, same stance as dynamic span names)."""
+    """Lines of ``executor.execute(...)`` / bare ``execute(...)`` /
+    ``submit_predict(...)`` (bare or as a method) calls with neither a
+    ``tenant=`` keyword nor a ``**kwargs`` spread (a spread may carry
+    the tag; it is not statically checkable and is skipped, same
+    stance as dynamic span names)."""
     out = []
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call):
             continue
         f = node.func
-        is_execute = (
+        is_dispatch = (
             (isinstance(f, ast.Attribute) and f.attr == "execute"
              and isinstance(f.value, ast.Name)
              and f.value.id == "executor")
-            or (isinstance(f, ast.Name) and f.id == "execute"))
-        if not is_execute:
+            or (isinstance(f, ast.Name)
+                and f.id in _TENANT_DISPATCH_NAMES)
+            or (isinstance(f, ast.Attribute)
+                and f.attr == "submit_predict"))
+        if not is_dispatch:
             continue
         kw_names = {kw.arg for kw in node.keywords}
         if "tenant" in kw_names or None in kw_names:
@@ -606,8 +619,9 @@ class TenantTagRule(Rule):
             return []
         return [self.finding(
             src, line,
-            "executor.execute() on the serving plane without a tenant= "
-            "argument — the request burns the shared default lane's "
-            "fair-queueing quota; thread the caller's tenant tag "
-            "(tenant=None to adopt the ambient tenant_scope)")
+            "serving-plane dispatch (executor.execute / "
+            "submit_predict) without a tenant= argument — the request "
+            "burns the shared default lane's fair-queueing quota; "
+            "thread the caller's tenant tag (tenant=None to adopt the "
+            "ambient tenant_scope)")
             for line in untagged_execute_calls(src.tree)]

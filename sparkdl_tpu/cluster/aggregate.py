@@ -37,7 +37,8 @@ __all__ = ["build_snapshot", "merge_snapshots", "merged_run_report",
 def build_snapshot(worker: str, pid: int, tel: Any, monitor: Any, *,
                    tasks: int, rows: int, exec_s: float,
                    phases: Optional[Dict[str, Any]] = None,
-                   span_ring: Optional[Dict[str, Any]] = None
+                   span_ring: Optional[Dict[str, Any]] = None,
+                   serving: Optional[Dict[str, Any]] = None
                    ) -> Dict[str, Any]:
     """One worker's end-of-run snapshot (worker-side, while its
     telemetry scope and health monitor are still active): the same
@@ -46,7 +47,9 @@ def build_snapshot(worker: str, pid: int, tel: Any, monitor: Any, *,
     ``span_ring`` is :meth:`Tracer.export_ring`'s shippable view of the
     worker's spans (rebased onto the coordinator's clock); the key is
     absent entirely when tracing is off, keeping the off-path snapshot
-    byte-identical."""
+    byte-identical. Same stance for ``serving``: a worker that hosted
+    replicated deployments ships its ``WorkerServingPlane.stats()``
+    here, and the key is absent when the serving plane never ran."""
     snap = {
         "worker": worker,
         "pid": pid,
@@ -64,6 +67,8 @@ def build_snapshot(worker: str, pid: int, tel: Any, monitor: Any, *,
         snap["tenants"] = tenants
     if span_ring is not None:
         snap["span_ring"] = span_ring
+    if serving is not None:
+        snap["serving"] = serving
     return snap
 
 
@@ -141,6 +146,14 @@ def merge_snapshots(snapshots: Sequence[Dict[str, Any]],
     ``p99_s`` is the WORST worker's p99, since percentiles cannot be
     merged exactly across independent histograms). Both keys are absent
     when the features are off.
+
+    With the cluster serving plane active (any snapshot carrying a
+    ``serving`` section), a ``serving`` subsection folds the per-worker
+    replica stats together: predicts/errors summed, plus the
+    worker-side replica map ``{model: {version: [workers deployed]}}``
+    — the router enriches it at close with its coordinator-side view
+    (``serving.router``: routing, failovers, cutovers). Absent when no
+    worker served.
     """
     snapshots = [s for s in snapshots if s]
     health_totals = sum_health_counters(snapshots)
@@ -186,6 +199,23 @@ def merge_snapshots(snapshots: Sequence[Dict[str, Any]],
                 agg["p99_s"] = p99
     if tenants:
         out["tenants"] = dict(sorted(tenants.items()))
+    serving_workers = {s["worker"]: s["serving"] for s in snapshots
+                       if s.get("serving") is not None}
+    if serving_workers:
+        replicas: Dict[str, Dict[str, List[str]]] = {}
+        for wname, srv in serving_workers.items():
+            for dep in srv.get("deployments", ()):
+                versions = replicas.setdefault(dep["model"], {})
+                versions.setdefault(dep["version"], []).append(wname)
+        out["serving"] = {
+            "workers": serving_workers,
+            "predicts": sum(s.get("predicts", 0)
+                            for s in serving_workers.values()),
+            "errors": sum(s.get("errors", 0)
+                          for s in serving_workers.values()),
+            "replicas": {m: {v: sorted(ws) for v, ws in sorted(vs.items())}
+                         for m, vs in sorted(replicas.items())},
+        }
     if autoscale_events:
         events = [dict(e) for e in autoscale_events]
         out["autoscale"] = {

@@ -149,7 +149,8 @@ class _Worker:
 
     __slots__ = ("wid", "proc", "queue", "conn", "clock", "assigned",
                  "tokens", "outstanding_rows", "finished", "lost",
-                 "draining", "drain_started", "drain_reason", "pilled")
+                 "draining", "drain_started", "drain_reason", "pilled",
+                 "serving_assigned")
 
     def __init__(self, wid: int, proc: Any, queue: Any, conn: Any,
                  clock: Any) -> None:
@@ -159,6 +160,11 @@ class _Worker:
         self.conn = conn  # parent's read end; None once EOF-drained
         self.clock = clock  # clock-handshake pipe; None once answered
         self.assigned: Set[int] = set()
+        # in-flight SERVING request ids (predicts + prepare acks) on this
+        # worker — tracked separately from partition tasks so worker
+        # death surfaces the precise set to re-admit, and a draining
+        # worker is not pilled from under an unanswered predict
+        self.serving_assigned: Set[int] = set()
         self.tokens: Set[str] = set()
         self.outstanding_rows = 0
         self.finished = False  # final snapshot received
@@ -224,7 +230,8 @@ class ClusterRouter:
         # its own autoscaler (elasticity is coordinator-owned)
         config.update(cluster_workers=0, cluster_inflight_partitions=None,
                       decode_workers=0, decode_pool_inflight=None,
-                      durable_dir=None, cluster_autoscale=False)
+                      durable_dir=None, cluster_autoscale=False,
+                      serving_cluster=False)
         import cloudpickle
 
         # the coordinator's root span context ships in the boot blob:
@@ -235,6 +242,12 @@ class ClusterRouter:
             {"config": config, "platform": jax.default_backend(),
              "root_ctx": tel.root_context if tel is not None else None})
         self._lock = threading.Lock()
+        # the attached cluster serving handler (serving/cluster.py), or
+        # None while the serving plane is off — srv_* replies, precise
+        # worker-loss request sets, and post-spawn replica top-ups route
+        # to it. Lock order is always serving-handler lock -> router
+        # lock: the router calls the handler with its own lock RELEASED.
+        self._serving: Optional[Any] = None
         self._pending: Dict[int, _Task] = {}
         self._ids = itertools.count(1)
         self._ops_blobs: Dict[str, bytes] = {}
@@ -512,6 +525,81 @@ class ClusterRouter:
             telemetry.gauge_set(telemetry.M_CLUSTER_OUTSTANDING_ROWS,
                                 total)
 
+    # -- the serving-plane transport (serving/cluster.py) --------------------
+
+    def serving_attach(self, handler: Any) -> None:
+        """Attach the cluster serving handler: ``srv_*`` worker replies
+        (:meth:`on_message`), worker-loss notifications carrying the
+        precise lost request ids (:meth:`on_worker_lost`), and
+        post-spawn replica top-ups (:meth:`on_worker_spawn`) route to
+        it. One handler per router; attaching replaces the previous."""
+        with self._lock:
+            self._serving = handler
+
+    def serving_live_workers(self) -> List[int]:
+        """Worker ids eligible for NEW serving dispatches: live and not
+        draining — a draining worker finishes its in-flight predicts
+        but admits no new ones (the same admission stance batch
+        dispatch takes)."""
+        with self._lock:
+            return [w.wid for w in self._workers
+                    if not w.lost and not w.finished and not w.draining]
+
+    def serving_worker_name(self, wid: int) -> str:
+        with self._lock:
+            worker = self._worker_by_wid_locked(wid)
+            return (worker.proc.name if worker is not None
+                    else f"sparkdl-cluster-{wid}")
+
+    def serving_send(self, wid: int, msg: Tuple,
+                     req_id: Optional[int] = None) -> None:
+        """Enqueue one serving-plane message on worker ``wid``'s private
+        task queue (replies come back over its result pipe as ``srv_*``
+        messages routed to the attached handler). ``req_id`` registers
+        an expected reply under ``serving_assigned``: worker death then
+        surfaces exactly this request for re-admission, and a draining
+        worker is pilled only once it has answered."""
+        with self._lock:
+            worker = self._worker_by_wid_locked(wid)
+            if (worker is None or worker.lost or worker.finished
+                    or self._closed):
+                raise resilience.ServingReplicaLost(
+                    f"cluster worker {wid} is gone (or the router is "
+                    "closed); cannot dispatch the serving message")
+            if worker.draining and req_id is not None:
+                raise resilience.WorkerDraining(
+                    f"cluster worker {wid} is draining; it admits no "
+                    "new serving requests")
+            try:
+                worker.queue.put(msg)
+            except ValueError:
+                raise resilience.ServingReplicaLost(
+                    f"cluster worker {wid}'s task queue is closed"
+                ) from None
+            if req_id is not None:
+                worker.serving_assigned.add(req_id)
+
+    def serving_done(self, wid: int, req_id: int) -> None:
+        """Discount one answered (or abandoned) serving request from its
+        worker; a draining worker whose partition AND serving in-flight
+        sets just emptied is pilled here — the serving analogue of the
+        ``_on_message`` drain hook."""
+        with self._lock:
+            worker = self._worker_by_wid_locked(wid)
+            if worker is None:
+                return
+            worker.serving_assigned.discard(req_id)
+            if (worker.draining and not worker.assigned
+                    and not worker.serving_assigned and not worker.pilled
+                    and not self._closed):
+                self._pill_locked(worker)
+
+    def _worker_by_wid_locked(self, wid: int) -> Optional[_Worker]:
+        for w in self._workers:
+            if w.wid == wid:
+                return w
+        return None
+
     # -- the collector thread ------------------------------------------------
 
     def _collect(self) -> None:
@@ -566,6 +654,14 @@ class ClusterRouter:
 
     def _on_message(self, worker: _Worker, msg: Tuple) -> None:
         kind = msg[0]
+        if isinstance(kind, str) and kind.startswith("srv_"):
+            # serving-plane reply: the attached handler resolves its
+            # waiter and discounts via serving_done (which owns the
+            # drain-pill hook for serving in-flight sets)
+            handler = self._serving
+            if handler is not None:
+                handler.on_message(worker.wid, msg)
+            return
         if kind == "final":
             with self._lock:
                 worker.finished = True
@@ -584,9 +680,11 @@ class ClusterRouter:
                 self._discount_locked(task)
             total = self._outstanding_locked()
             if (worker.draining and not worker.assigned
+                    and not worker.serving_assigned
                     and not worker.pilled and not self._closed):
-                # last in-flight task just finished: retire the worker
-                # (it ships its final snapshot and EOFs cleanly)
+                # last in-flight task just finished (and no serving
+                # request is awaiting an answer): retire the worker (it
+                # ships its final snapshot and EOFs cleanly)
                 self._pill_locked(worker)
         if task is None:
             return  # re-dispatch duplicate or abandoned attempt
@@ -626,7 +724,8 @@ class ClusterRouter:
             worker.draining = True
             worker.drain_started = time.monotonic()
             worker.drain_reason = reason
-            if not worker.assigned and not worker.pilled:
+            if (not worker.assigned and not worker.serving_assigned
+                    and not worker.pilled):
                 self._pill_locked(worker)
             if reason == "preemption":
                 spawned = self._ensure_capacity_locked()
@@ -674,6 +773,11 @@ class ClusterRouter:
         self._gauge_workers_locked_free()
         self._note_autoscale_event("spawn", worker=worker.proc.name,
                                    reason=reason)
+        handler = self._serving
+        if handler is not None:
+            # replica top-up: deployments fan out to the replacement so
+            # the serving plane regains its replication factor
+            handler.on_worker_spawn(worker.wid)
 
     def _gauge_workers_locked_free(self) -> None:
         if telemetry.active() is None:
@@ -698,6 +802,7 @@ class ClusterRouter:
         ``ClusterWorkerLost`` and the supervisor's retry loop decides."""
         redispatched: List[_Task] = []
         failed: List[_Task] = []
+        srv_lost: List[int] = []
         lost = False
         drained = False
         with self._lock:
@@ -707,6 +812,12 @@ class ClusterRouter:
             if not worker.finished and not self._closed:
                 lost = True
                 worker.lost = True
+                # the precise serving loss set: exactly the request ids
+                # awaiting an answer from this worker — handed to the
+                # serving handler (outside the lock) for deadline-bounded
+                # re-admission with exactly-once failover accounting
+                srv_lost = sorted(worker.serving_assigned)
+                worker.serving_assigned.clear()
                 # abandon the dead worker's queue WITHOUT joining its
                 # feeder thread (it may be blocked writing to a pipe
                 # nobody will ever read — the decode-pool lesson)
@@ -757,6 +868,10 @@ class ClusterRouter:
         for task in failed:
             task.event.set()
             self._sem.release()
+        if lost:
+            handler = self._serving
+            if handler is not None:
+                handler.on_worker_lost(worker.wid, srv_lost)
 
     # -- the autoscaler -------------------------------------------------------
 
@@ -910,6 +1025,12 @@ class ClusterRouter:
                 "cluster router closed mid-stream")
             task.event.set()
             self._sem.release()
+        handler = self._serving
+        if handler is not None:
+            # serving requests still unanswered at this point are
+            # orphans (their worker exited without replying): fail them
+            # classified instead of letting a waiter spin to deadline
+            handler.on_close()
         self._wake_w.close()
         self._wake_r.close()
         with self._lock:
@@ -933,6 +1054,17 @@ class ClusterRouter:
             aggregate.merged_run_report(tel, finals, lost_workers=lost,
                                         autoscale_events=scale_events)
             if tel is not None else None)
+        if handler is not None:
+            # the coordinator-side router view (replica map, failover
+            # tallies, cutovers) joins the worker-side serving stats the
+            # snapshot merge already folded in — one `serving` section
+            # per report, both halves of the plane
+            srv = handler.report_section()
+            self.cluster_report.setdefault("serving", {})["router"] = srv
+            if self.run_report is not None:
+                cluster_sec = self.run_report.setdefault("cluster", {})
+                cluster_sec.setdefault("serving", {})["router"] = srv
+                self.run_report["serving"] = cluster_sec["serving"]
 
     def __enter__(self) -> "ClusterRouter":
         return self

@@ -31,6 +31,12 @@ pools under the coordinator's pool).
 
 Protocol (parent -> worker queue):
   ``("ops", token, blob)``                      register an op chain
+  ``("srv_*", ...)``                            cluster serving plane
+      (``sparkdl_tpu/serving/cluster.py``): deploy/retire/pin fan-out,
+      two-phase cutover prepares, and routed predicts. The first
+      ``srv_*`` message lazily builds this worker's
+      ``WorkerServingPlane`` (own ModelRegistry + residency budget) —
+      a batch-only cluster run never imports the serving plane
   ``("task", task_id, index, token, ipc, crash, preempt, tenant,
   ctx)``  run one partition; ``ctx`` is the coordinator's
       dispatch-span ``SpanContext`` (None with tracing off) — the
@@ -158,6 +164,7 @@ def _worker_main(worker_id: int, tasks: Any, conn: Any, owner_pid: int,
         clock_offset = telemetry.clock_handshake(clock_conn)
         clock_conn.close()
     ops_cache: Dict[str, Any] = {}
+    serving_plane = None
     tasks_done = 0
     rows_out = 0
     exec_s_total = 0.0
@@ -195,6 +202,15 @@ def _worker_main(worker_id: int, tasks: Any, conn: Any, owner_pid: int,
             if msg[0] == "ops":
                 _, token, blob = msg
                 ops_cache[token] = cloudpickle.loads(blob)
+                continue
+            if isinstance(msg[0], str) and msg[0].startswith("srv_"):
+                if serving_plane is None:
+                    from sparkdl_tpu.serving.cluster import \
+                        WorkerServingPlane
+
+                    serving_plane = WorkerServingPlane(worker_id, name,
+                                                       conn)
+                serving_plane.handle(msg)
                 continue
             _, task_id, index, token, payload, crash, preempt, tenant, \
                 ctx = msg
@@ -249,6 +265,8 @@ def _worker_main(worker_id: int, tasks: Any, conn: Any, owner_pid: int,
         snapshot = aggregate.build_snapshot(
             name, os.getpid(), tel, monitor, tasks=tasks_done,
             rows=rows_out, exec_s=exec_s_total,
-            phases=profiling.phase_stats(), span_ring=span_ring)
+            phases=profiling.phase_stats(), span_ring=span_ring,
+            serving=(serving_plane.stats()
+                     if serving_plane is not None else None))
     conn.send(("final", worker_id, snapshot))
     conn.close()

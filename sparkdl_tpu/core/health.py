@@ -88,6 +88,15 @@ SERVING_EVICTED = "serving_evicted"      # serving: residency dropped a
 SERVING_COLD_START = "serving_cold_start"  # serving: loader ran on a
                                          # residency miss (first load OR
                                          # reload after eviction)
+SERVING_FAILOVER = "serving_failover"    # serving: one in-flight predict
+                                         # re-admitted to a surviving
+                                         # replica after its worker died
+                                         # (exactly one event per moved
+                                         # request)
+SERVING_PREPARE_FAILED = "serving_prepare_failed"  # serving: a cluster
+                                         # cutover's prepare phase failed
+                                         # on some worker — rolled back,
+                                         # v1 still serving everywhere
 CLUSTER_WORKER_STARTED = "cluster_worker_started"  # cluster: a worker
                                          # process was spawned
 CLUSTER_WORKER_LOST = "cluster_worker_lost"  # cluster: a worker died

@@ -154,6 +154,17 @@ class DrainTimeout(RuntimeError):
     cycle."""
 
 
+class ServingReplicaLost(RuntimeError):
+    """A cluster worker serving an online predict died (or every
+    surviving replica was draining/lost) and the request could not be
+    re-admitted within its failover budget
+    (``sparkdl_tpu/serving/cluster.py``). RETRYABLE by definition:
+    predict is idempotent and journal-free — the client (or the serving
+    router's own deadline-bounded re-admission) simply runs it again on
+    a surviving replica. Defined here so :func:`classify` stays the
+    single taxonomy source without an import cycle."""
+
+
 class StaleCheckpointWriter(RuntimeError):
     """A checkpoint save was refused by the fencing token: this process
     belongs to a superseded gang incarnation and a newer writer has
@@ -216,7 +227,8 @@ def classify(err: BaseException) -> str:
         return OOM
     if isinstance(err, (Preemption, TransferStall, ExecutorOverloaded,
                         ExecutorCircuitOpen, DecodeWorkerLost,
-                        ClusterWorkerLost, WorkerDraining, DrainTimeout)):
+                        ClusterWorkerLost, WorkerDraining, DrainTimeout,
+                        ServingReplicaLost)):
         return RETRYABLE
     if isinstance(err, DeadlineExceeded):
         return FATAL  # the deadline IS the retry budget; never retry past it
@@ -392,6 +404,14 @@ INJECTION_POINTS: Dict[str, Tuple[str, Optional[Callable[[], BaseException]]]] =
         "EOF death detection, precise re-dispatch of the dead worker's "
         "in-flight partitions to survivors, and the merged-report "
         "accounting for a lost worker", None),
+    "serving_worker_kill": (
+        "behavioral: the cluster serving router marks the next "
+        "dispatched predict so its worker process SIGKILLs itself on "
+        "receipt (sparkdl_tpu/serving/cluster.py); ctx carries model "
+        "and request — exercises replica-death failover: every "
+        "in-flight predict on the dead worker re-admits to a surviving "
+        "replica within the caller's deadline, with exactly-once "
+        "serving_failover accounting", None),
     "cluster_worker_preempt": (
         "behavioral: the cluster router marks the next dispatched "
         "partition so its worker process SIGTERMs itself on receipt — "

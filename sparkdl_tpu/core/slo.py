@@ -354,6 +354,40 @@ def default_serving_rules(model_targets: Optional[Dict[str, float]] = None,
     return tuple(rules)
 
 
+# A failover is a worker death made invisible — one or two per window is
+# the plane doing its job; a sustained rate means replicas are dying
+# faster than they respawn and the survivors are absorbing everything.
+DEFAULT_SERVING_FAILOVER_RATE_PER_S = 0.5
+
+
+def cluster_serving_rules(model_targets: Optional[Dict[str, float]] = None,
+                          window_s: float = DEFAULT_WINDOW_S,
+                          for_s: float = DEFAULT_HOLD_S,
+                          request_p99_s: float = DEFAULT_SERVING_P99_S,
+                          shed_rate_per_s: float =
+                          DEFAULT_SERVING_SHED_RATE_PER_S,
+                          failover_rate_per_s: float =
+                          DEFAULT_SERVING_FAILOVER_RATE_PER_S,
+                          ) -> Tuple[SLORule, ...]:
+    """The cluster serving plane's rule set: everything
+    :func:`default_serving_rules` watches — in cluster mode every
+    request is routed (and its latency observed) coordinator-side, so
+    each per-model ``sparkdl.serving.request_s.<model>`` histogram IS
+    the per-deployment windowed p99 **across all replicas** — plus a
+    sustained-failover rule on the ``serving_failover`` health mirror
+    (replicas dying faster than the plane can hide it)."""
+    rules = list(default_serving_rules(
+        model_targets, window_s=window_s, for_s=for_s,
+        request_p99_s=request_p99_s, shed_rate_per_s=shed_rate_per_s))
+    rules.append(
+        SLORule("serving_failover_rate",
+                metric=telemetry.HEALTH_METRIC_PREFIX
+                + health.SERVING_FAILOVER,
+                window_s=window_s, threshold=failover_rate_per_s,
+                comparator=">=", stat="rate_per_s", for_s=for_s))
+    return tuple(rules)
+
+
 def tenant_queue_wait_rules(tenant_targets: Dict[str, float],
                             window_s: float = DEFAULT_WINDOW_S,
                             for_s: float = DEFAULT_HOLD_S,

@@ -119,6 +119,9 @@ SPAN_DECODE_CHUNK = "sparkdl.decode_chunk"    # one chunk decoded inside
 SPAN_SERVING_SHADOW = "sparkdl.serving_shadow"  # shadow-lane replay of
                                               # one serving request
                                               # (serving/server.py)
+SPAN_SERVING_PREDICT = "sparkdl.serving_predict"  # worker-side execution
+                                              # of one cluster-routed
+                                              # predict (serving/cluster.py)
 
 CANONICAL_SPAN_NAMES = frozenset({
     SPAN_RUN, SPAN_RUNNER_ATTEMPT, SPAN_FIT, SPAN_EPOCH,
@@ -126,7 +129,7 @@ CANONICAL_SPAN_NAMES = frozenset({
     SPAN_MATERIALIZE, SPAN_TASK, SPAN_TASK_ATTEMPT,
     SPAN_COMPILE, SPAN_COALESCED_LAUNCH, SPAN_DECODE_POOL,
     SPAN_MODEL_LOAD, SPAN_CLUSTER_DISPATCH, SPAN_CLUSTER_TASK,
-    SPAN_DECODE_CHUNK, SPAN_SERVING_SHADOW,
+    SPAN_DECODE_CHUNK, SPAN_SERVING_SHADOW, SPAN_SERVING_PREDICT,
     # phase names (core/profiling.py constants + literal call sites)
     "sparkdl.decode", "sparkdl.stage", "sparkdl.stage_batch",
     "sparkdl.host_stage", "sparkdl.host_resize", "sparkdl.host_wait",
@@ -185,6 +188,19 @@ M_SERVING_SHADOW_DIVERGENCE = "sparkdl.serving.shadow_divergence"
                                                        # histogram (max
                                                        # |active-shadow|)
 M_SERVING_EVICTIONS = "sparkdl.serving.evictions"      # counter
+# Cluster serving plane (serving/cluster.py, docs/SERVING.md "Cluster
+# serving"): replicated deployments across cluster workers. The
+# failover counter is the router's own canonical series (the
+# serving_failover health mirror carries the same count — the merged
+# report cross-checks them); the replicas gauge tracks the live replica
+# set of the deployment most recently routed.
+M_SERVING_FAILOVER = "sparkdl.serving.failover"        # counter (moved
+                                                       # in-flight
+                                                       # requests)
+M_SERVING_REPLICAS = "sparkdl.serving.replicas"        # gauge (live
+                                                       # replicas of the
+                                                       # last-routed
+                                                       # deployment)
 # Cluster inference plane (sparkdl_tpu/cluster/, docs/DISTRIBUTED.md
 # "Cluster inference"): the router's load/latency view. Worker-loss and
 # re-dispatch COUNTS also arrive as sparkdl.health.* mirrors; the
@@ -241,6 +257,8 @@ CANONICAL_METRIC_KINDS: Dict[str, str] = {
     M_SERVING_QUEUE_DEPTH: "gauge",
     M_SERVING_SHADOW_DIVERGENCE: "histogram",
     M_SERVING_EVICTIONS: "counter",
+    M_SERVING_FAILOVER: "counter",
+    M_SERVING_REPLICAS: "gauge",
     M_CLUSTER_OUTSTANDING_ROWS: "gauge",
     M_CLUSTER_DISPATCH_S: "histogram",
     M_CLUSTER_REDISPATCH: "counter",
@@ -1267,6 +1285,9 @@ class SnapshotExporter:
             "cumulative": tel.metrics.snapshot(),
             "executor": self._executor_status(),
         }
+        serving = self._serving_status()
+        if serving is not None:
+            snap["serving"] = serving
         if slo_state is not None:
             snap["slo"] = slo_state
         if final:
@@ -1298,6 +1319,20 @@ class SnapshotExporter:
         if mod is None:
             return None
         return mod.service().status()
+
+    @staticmethod
+    def _serving_status() -> Optional[Dict[str, Any]]:
+        """Per-deployment replica map of the cluster serving router —
+        same ``sys.modules`` stance as :meth:`_executor_status`: a
+        process that never imported the cluster serving plane must not
+        pay for it (and the key stays absent, keeping single-process
+        snapshots byte-identical)."""
+        import sys
+
+        mod = sys.modules.get("sparkdl_tpu.serving.cluster")
+        if mod is None:
+            return None
+        return mod.exporter_status()
 
     # -- the timeline that feeds RunReport -----------------------------------
 

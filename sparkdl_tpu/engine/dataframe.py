@@ -188,6 +188,26 @@ class EngineConfig:
     autoscale_queue_wait_high_s: float = 0.5
     autoscale_queue_wait_low_s: float = 0.05
     autoscale_rows_per_worker_high: int = 4096
+    # -- cluster serving plane (sparkdl_tpu/serving/cluster.py,
+    # docs/SERVING.md "Cluster serving") ---------------------------------------
+    # Route ModelServer.predict through the cluster router: deployments
+    # replicate across the cluster workers, requests route with
+    # load/locality awareness, worker death re-admits in-flight predicts
+    # to survivors within the caller's deadline, and hot-swap becomes a
+    # cluster-atomic two-phase cutover. Requires cluster_workers > 0;
+    # False (default) keeps the single-process serving path
+    # byte-identical — serving/cluster.py is never even imported.
+    # Always forced off INSIDE workers (a replica must not recurse).
+    serving_cluster: bool = False
+    # Per-worker HBM residency budget for replicated deployments; None
+    # gives each worker-side registry an unbudgeted cache (models stay
+    # resident until retired).
+    serving_worker_residency_bytes: Optional[int] = None
+    # How many times one in-flight predict may be re-admitted after
+    # replica deaths before failing with ServingReplicaLost (the
+    # caller's deadline bounds it anyway; this bounds pathological
+    # rolling-death churn).
+    serving_failover_max: int = 2
     # -- per-tenant fair queueing (core/executor.py, docs/RESILIENCE.md
     # "Tenant fairness") --------------------------------------------------------
     # Relative deficit-round-robin weights per tenant tag; tenants absent
@@ -263,6 +283,8 @@ class EngineConfig:
                  cls.autoscale_queue_wait_high_s,
                  cls.autoscale_queue_wait_low_s,
                  cls.autoscale_rows_per_worker_high,
+                 cls.serving_cluster, cls.serving_worker_residency_bytes,
+                 cls.serving_failover_max,
                  (None if cls.executor_tenant_weights is None
                   else tuple(sorted(cls.executor_tenant_weights.items()))),
                  cls.executor_default_tenant, cls.job_tenant,
@@ -380,6 +402,17 @@ class EngineConfig:
             raise ValueError(
                 "EngineConfig.autoscale_rows_per_worker_high must be "
                 f">= 1, got {cls.autoscale_rows_per_worker_high!r}")
+        if not isinstance(cls.serving_cluster, bool):
+            raise ValueError(
+                "EngineConfig.serving_cluster must be a bool, got "
+                f"{cls.serving_cluster!r}")
+        positive("serving_worker_residency_bytes",
+                 cls.serving_worker_residency_bytes)
+        if cls.serving_failover_max < 0:
+            raise ValueError(
+                "EngineConfig.serving_failover_max must be >= 0 (0 "
+                "fails a moved request on first replica death), got "
+                f"{cls.serving_failover_max!r}")
         if cls.executor_tenant_weights is not None:
             if not isinstance(cls.executor_tenant_weights, dict):
                 raise ValueError(

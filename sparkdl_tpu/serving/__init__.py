@@ -8,6 +8,13 @@ versioned deployments with shadow traffic, atomic cutover and rollback;
 a :class:`~sparkdl_tpu.serving.residency.ResidencyManager` keeps many
 models resident under a byte-accounted HBM budget with LRU/weighted
 eviction, pinning, and ``sparkdl.model_load`` cold-start spans.
+
+The cluster serving plane (``sparkdl_tpu/serving/cluster.py``:
+replicated deployments, worker-death failover, cluster-atomic hot
+swap) is deliberately NOT imported here — it loads only when
+``EngineConfig.serving_cluster`` arms it, so a single-process serving
+stack never pays for (or observes) the cluster machinery. Its names
+resolve lazily through this package's ``__getattr__``.
 """
 
 from sparkdl_tpu.serving.registry import (  # noqa: F401
@@ -26,6 +33,8 @@ from sparkdl_tpu.serving.server import (  # noqa: F401
 )
 
 __all__ = [
+    "ClusterServingRouter",
+    "CutoverFailed",
     "Deployment",
     "ModelRegistry",
     "ModelServer",
@@ -33,5 +42,20 @@ __all__ = [
     "ResidencyExhausted",
     "ResidencyManager",
     "ServingOverloaded",
+    "WorkerServingPlane",
     "default_registry",
 ]
+
+_LAZY_CLUSTER = ("ClusterServingRouter", "CutoverFailed",
+                 "WorkerServingPlane")
+
+
+def __getattr__(name):
+    # PEP 562 lazy export: touching a cluster-serving name imports the
+    # module; merely importing the serving package never does
+    if name in _LAZY_CLUSTER:
+        from sparkdl_tpu.serving import cluster as _cluster
+
+        return getattr(_cluster, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,1035 @@
+"""Cluster serving plane: replicated deployments, failover, atomic swap.
+
+``EngineConfig.serving_cluster`` (with ``cluster_workers > 0``) lifts
+the serving plane from one process onto the cluster
+(docs/SERVING.md "Cluster serving"):
+
+- **Replication.** Every deployed (model, version) fans out to every
+  live cluster worker as a cloudpickled loader blob — shipped ONCE per
+  worker over the router's private task queues, the same ship-once
+  stance as the batch plane's op-chain blobs. Each worker hosts a full
+  replica stack (:class:`WorkerServingPlane`: its own ModelRegistry +
+  an optional ``serving_worker_residency_bytes`` budget), so a replica
+  is a real serving plane, not a thin stub.
+- **Routing.** :meth:`ClusterServingRouter.predict` routes with load
+  and locality awareness: workers that already hold the version
+  HBM-resident win, least-in-flight breaks ties, and a fully-cold
+  version designates ONE warming worker (single-flight across the
+  cluster — N callers never trigger N cold loads).
+- **Failover.** A worker death surfaces (via the router's EOF reap)
+  exactly the serving request ids that worker owed answers for; each
+  re-admits to a surviving replica within the CALLER's remaining
+  deadline — predict is idempotent and journal-free, so the move is
+  classified RETRYABLE internally and invisible to the caller beyond
+  latency. Accounting is exactly-once: one ``serving_failover`` health
+  event per moved request, recorded at the single re-admission site.
+- **Cluster-atomic hot swap.** :meth:`ClusterServingRouter.cutover` is
+  two-phase: *prepare* makes every live replica load the new version
+  and ack residency (pinned, so it cannot evict before commit);
+  *commit* closes the deployment's admission gate, drains in-flight
+  predicts, flips ONE pointer, moves the pins, reopens. No window
+  exists where two callers get different versions — the last old-
+  version response strictly precedes the first new-version admission.
+  Any prepare failure rolls back (new version unpinned everywhere it
+  loaded, ``serving_prepare_failed`` recorded) with the old version
+  still serving everywhere.
+
+Lock order is strict and one-way: the serving lock may take the router
+lock (``serving_send`` / ``serving_live_workers`` / ``serving_done``),
+NEVER the reverse — the router invokes every handler callback
+(``on_message`` / ``on_worker_lost`` / ``on_worker_spawn`` /
+``on_close``) with its own lock released.
+
+This module is imported ONLY when the knobs arm it
+(``ModelServer._cluster`` resolves through ``sys.modules``); a
+``cluster_workers=0`` process keeps the single-process serving path
+byte-identical and never loads this file.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import signal
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from sparkdl_tpu.core import executor, health, resilience, telemetry
+from sparkdl_tpu.serving import server as _server
+from sparkdl_tpu.serving.registry import ModelRegistry
+from sparkdl_tpu.serving.residency import ResidencyManager
+
+__all__ = ["ClusterServingRouter", "CutoverFailed", "WorkerServingPlane",
+           "exporter_status", "maybe_cluster_serving", "reset"]
+
+# Poll cadences: waiters re-check deadline/closed between event waits
+# (defense against lost wakeups, same stance as the batch router).
+_WAIT_POLL_S = 0.05
+_GATE_POLL_S = 0.05
+# Default bound on a cutover's prepare acks and commit drain — cold
+# loads are slow, but a wedged replica must not hold the swap forever.
+_CUTOVER_TIMEOUT_S = 60.0
+
+
+class CutoverFailed(RuntimeError):
+    """A cluster-atomic cutover aborted — prepare failed on some
+    replica (or the commit drain timed out) and was rolled back: the
+    previous version is still serving everywhere, nothing flipped."""
+
+
+# =============================================================================
+# Worker side: one replica stack per cluster worker process
+# =============================================================================
+
+class WorkerServingPlane:
+    """One cluster worker's serving replica: a private ModelRegistry
+    (plus a byte-budgeted ResidencyManager when
+    ``EngineConfig.serving_worker_residency_bytes`` is set) fed by
+    ``srv_*`` messages off the worker's task queue. Single-threaded by
+    construction — the worker loop is the only caller — so no locking
+    here; replies go back over the worker's private result pipe (one
+    writer per pipe, the transport invariant)."""
+
+    def __init__(self, worker_id: int, name: str, conn: Any) -> None:
+        from sparkdl_tpu.engine.dataframe import EngineConfig
+
+        self.worker_id = worker_id
+        self.name = name
+        self._conn = conn
+        budget = EngineConfig.serving_worker_residency_bytes
+        self._residency: Optional[ResidencyManager] = (
+            ResidencyManager(budget) if budget else None)
+        self._registry = ModelRegistry(residency=self._residency)
+        self._deployed: Dict[Tuple[str, str], Any] = {}
+        self._predicts = 0
+        self._errors = 0
+
+    # -- message dispatch ----------------------------------------------------
+
+    def handle(self, msg: Tuple) -> None:
+        kind = msg[0]
+        if kind == "srv_deploy":
+            self._deploy(*msg[1:])
+        elif kind == "srv_retire":
+            self._retire(*msg[1:])
+        elif kind == "srv_pin":
+            self._pin(*msg[1:])
+        elif kind == "srv_prepare":
+            self._prepare(*msg[1:])
+        elif kind == "srv_predict":
+            self._predict(*msg[1:])
+        # unknown srv_* kinds are ignored: a worker must not die (and
+        # take its in-flight answers with it) over a message it does
+        # not speak
+
+    def _deploy(self, name: str, version: str, blob: bytes,
+                batch_size: int, latency_target_ms: Optional[float],
+                pinned: bool) -> None:
+        """Idempotent: replica top-ups re-fan every deployment to a
+        fresh worker, and a retire/redeploy cycle reuses the immutable
+        registry record."""
+        key = (name, version)
+        if key not in self._deployed:
+            import cloudpickle
+
+            loader = cloudpickle.loads(blob)
+            try:
+                dep = self._registry.deploy(
+                    name, version, loader=loader,
+                    latency_target_ms=latency_target_ms,
+                    batch_size=batch_size)
+            except ValueError:
+                # redeploy after retire: versions are immutable, reuse
+                dep = self._registry.deployment(name, version)
+            self._deployed[key] = dep
+        if self._residency is not None:
+            self._residency.pin(name, version, pinned=bool(pinned))
+
+    def _retire(self, name: str, version: str) -> None:
+        if self._deployed.pop((name, version), None) is None:
+            return
+        if self._residency is not None:
+            self._residency.pin(name, version, pinned=False)
+            self._residency.evict(name, version)
+
+    def _pin(self, name: str, version: str, pinned: bool) -> None:
+        if (self._residency is not None
+                and (name, version) in self._deployed):
+            self._residency.pin(name, version, pinned=bool(pinned))
+
+    def _prepare(self, req_id: int, name: str, version: str) -> None:
+        """Phase one of a cluster-atomic cutover, replica-side: pin the
+        incoming version FIRST (it must not evict in the gap before
+        commit), then load it and ack residency."""
+        try:
+            dep = self._require(name, version)
+            if self._residency is not None:
+                self._residency.pin(name, version, pinned=True)
+            dep.model()  # cold load under the sparkdl.model_load span
+        # sparkdl: allow(broad-retry): not a retry — the failure ships typed to the coordinator, which owns the rollback decision
+        except Exception as e:  # noqa: BLE001 - nacked to coordinator
+            self._conn.send(("srv_prepared", req_id, False,
+                             f"{type(e).__name__}: {e}",
+                             self._resident_bytes()))
+            return
+        self._conn.send(("srv_prepared", req_id, True, None,
+                         self._resident_bytes()))
+
+    def _predict(self, req_id: int, name: str, version: str,
+                 payload: bytes, deadline_ms: Optional[float],
+                 priority: str, tenant: Optional[str], ctx: Any,
+                 crash: bool) -> None:
+        """One routed request: stage exactly as the single-process
+        ModelServer stages (shared helpers — the chaos proof compares
+        outputs bit-for-bit), execute through THIS worker's executor
+        choke point, answer over the pipe. ``crash`` is the armed
+        ``serving_worker_kill`` marker: die as hard as a machine loss,
+        no cleanup — the coordinator's failover leg takes it from
+        there."""
+        if crash:
+            os.kill(os.getpid(), signal.SIGKILL)
+        t0 = time.perf_counter()
+        try:
+            import cloudpickle
+
+            dep = self._require(name, version)
+            rows = cloudpickle.loads(payload)
+            batch, single = _server.stage_rows(dep, rows)
+            deadline = (resilience.Deadline(deadline_ms / 1e3)
+                        if deadline_ms is not None else None)
+            with telemetry.span(telemetry.SPAN_SERVING_PREDICT,
+                                parent=ctx, model=name, version=version,
+                                cluster_worker=self.worker_id):
+                out = executor.execute(
+                    dep.model(), batch, batch_size=dep.batch_size,
+                    priority=priority, deadline=deadline,
+                    coalesce_window_ms=_server.target_window_ms(dep),
+                    tenant=tenant)
+            import jax
+
+            if single:
+                out = jax.tree_util.tree_map(
+                    lambda a: np.asarray(a)[0], out)
+            else:
+                out = jax.tree_util.tree_map(np.asarray, out)
+            blob = cloudpickle.dumps(out)
+        # sparkdl: allow(broad-retry): not a retry — the error ships typed (with its classify kind) to the coordinator, whose failover loop owns the retry decision
+        except Exception as e:  # noqa: BLE001 - re-raised caller-side
+            self._errors += 1
+            self._conn.send(("srv_err", req_id, type(e).__name__,
+                             str(e), resilience.classify(e)))
+            return
+        self._predicts += 1
+        self._conn.send(("srv_ok", req_id, blob,
+                         {"exec_s": time.perf_counter() - t0,
+                          "resident_bytes": self._resident_bytes()}))
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _require(self, name: str, version: str) -> Any:
+        dep = self._deployed.get((name, version))
+        if dep is None:
+            raise KeyError(
+                f"worker {self.name} holds no deployment {name!r} "
+                f"v{version!r} — the deploy fan-out never arrived")
+        return dep
+
+    def _resident_bytes(self) -> int:
+        if self._residency is not None:
+            return self._residency.resident_bytes()
+        return sum(dep.resident_bytes()
+                   for dep in self._deployed.values())
+
+    def stats(self) -> Dict[str, Any]:
+        """This replica's end-of-run section, shipped inside the final
+        snapshot (``cluster/aggregate.py`` folds them cluster-wide)."""
+        deployments = []
+        for (name, version), dep in sorted(self._deployed.items()):
+            if self._residency is not None:
+                resident = self._residency.is_resident(name, version)
+            else:
+                resident = dep._model is not None  # no-budget: memoized
+            deployments.append({"model": name, "version": version,
+                                "resident": resident,
+                                "bytes": dep.resident_bytes()})
+        return {"worker": self.name,
+                "predicts": self._predicts,
+                "errors": self._errors,
+                "resident_bytes": self._resident_bytes(),
+                "deployments": deployments}
+
+
+# =============================================================================
+# Coordinator side: the replicated-serving router
+# =============================================================================
+
+class _VersionRoute:
+    """Coordinator-side view of one replicated (model, version);
+    every field is guarded by the owning ClusterServingRouter's lock."""
+
+    __slots__ = ("blob", "batch_size", "latency_target_ms", "deployed",
+                 "resident", "warming")
+
+    def __init__(self, blob: bytes, batch_size: int,
+                 latency_target_ms: Optional[float]) -> None:
+        self.blob = blob
+        self.batch_size = batch_size
+        self.latency_target_ms = latency_target_ms
+        self.deployed: Set[int] = set()   # wids holding the loader
+        self.resident: Set[int] = set()   # wids that have answered hot
+        self.warming: Optional[int] = None  # single-flight cold target
+
+class _DeploymentRoute:
+    """Per-model routing state. ``gate`` is the admission gate a
+    cluster-atomic cutover closes for its commit window; ``swap_lock``
+    serializes cutovers per deployment."""
+
+    __slots__ = ("name", "active", "previous", "versions", "gate",
+                 "inflight", "swap_lock")
+
+    def __init__(self, name: str, active: str) -> None:
+        self.name = name
+        self.active = active
+        self.previous: Optional[str] = None
+        self.versions: Dict[str, _VersionRoute] = {}
+        self.gate = threading.Event()
+        self.gate.set()
+        self.inflight = 0
+        self.swap_lock = threading.Lock()
+
+class _Call:
+    """One in-flight serving exchange (predict or prepare). Fields are
+    written under the serving lock; the waiter reads them only after
+    ``event`` is set."""
+
+    __slots__ = ("req_id", "kind", "name", "version", "payload",
+                 "deadline", "deadline_ms_total", "priority", "tenant",
+                 "ctx", "event", "blob", "meta", "result", "error",
+                 "worker", "failovers")
+
+    def __init__(self, kind: str, name: str) -> None:
+        self.req_id = 0
+        self.kind = kind
+        self.name = name
+        self.version: Optional[str] = None
+        self.payload: Optional[bytes] = None
+        self.deadline: Optional[float] = None  # absolute monotonic
+        self.deadline_ms_total: Optional[float] = None
+        self.priority = executor.PRIORITY_INTERACTIVE
+        self.tenant: Optional[str] = None
+        self.ctx: Any = None
+        self.event = threading.Event()
+        self.blob: Optional[bytes] = None
+        self.meta: Dict[str, Any] = {}
+        self.result: Optional[Tuple] = None  # prepare: (ok, err, bytes)
+        self.error: Optional[BaseException] = None
+        self.worker: Optional[int] = None
+        self.failovers = 0
+
+    def remaining_ms(self) -> Optional[float]:
+        if self.deadline is None:
+            return None
+        return max(0.0, (self.deadline - time.monotonic()) * 1e3)
+
+
+class ClusterServingRouter:
+    """Routes ``ModelServer.predict`` across the cluster's replica set
+    and owns failover re-admission plus the two-phase cutover. One
+    instance per :class:`~sparkdl_tpu.cluster.router.ClusterRouter`
+    (it attaches itself as the router's serving handler)."""
+
+    def __init__(self, router: Any) -> None:
+        self.router = router
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._routes: Dict[str, _DeploymentRoute] = {}
+        self._pending: Dict[int, _Call] = {}
+        self._ids = itertools.count(1)
+        self._wid_inflight: Dict[int, int] = {}
+        self._worker_bytes: Dict[int, int] = {}
+        self._predicts = 0
+        self._failovers = 0
+        self._moved: List[int] = []
+        self._cutovers = 0
+        self._prepare_failures = 0
+        self._closed = False
+        router.serving_attach(self)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    # -- deployment fan-out --------------------------------------------------
+
+    def _ensure(self, name: str, registry: Any,
+                adopt: bool = True) -> None:
+        """Reconcile the coordinator registry into the replica plane:
+        versions the routes have not seen fan out to every live worker
+        (ship-once), and — with ``adopt`` — a registry active pointer
+        the router has not adopted yet (someone called
+        ``registry.cutover`` directly) converges through the
+        cluster-atomic two-phase swap."""
+        deps = registry.deployments(name)
+        reg_active = registry.active_version(name)
+        with self._lock:
+            route = self._routes.get(name)
+            missing = [v for v in sorted(deps)
+                       if route is None or v not in route.versions]
+        blobs: Dict[str, bytes] = {}
+        if missing:
+            # pickling runs OUTSIDE the lock: loaders can close over
+            # real weights
+            import cloudpickle
+
+            for v in missing:
+                blobs[v] = cloudpickle.dumps(deps[v].loader)
+        with self._lock:
+            route = self._routes.get(name)
+            if route is None:
+                route = _DeploymentRoute(name, reg_active)
+                self._routes[name] = route
+            live: Optional[List[int]] = None
+            for v in missing:
+                if v in route.versions:
+                    continue  # raced with a sibling _ensure
+                dep = deps[v]
+                vr = _VersionRoute(blobs[v], dep.batch_size,
+                                   dep.latency_target_ms)
+                route.versions[v] = vr
+                if live is None:
+                    live = self.router.serving_live_workers()
+                self._fan_deploy_locked(name, route, v, vr, live)
+            mismatch = (adopt and route.active != reg_active
+                        and reg_active in route.versions)
+        if mismatch:
+            self.cutover(name, registry, reg_active)
+
+    def _fan_deploy_locked(self, name: str, route: _DeploymentRoute,
+                           version: str, vr: _VersionRoute,
+                           wids: Sequence[int]) -> None:
+        """Ship one version's loader blob to ``wids``. Under the
+        serving lock so a version never becomes routable on a worker
+        before its deploy message is enqueued (the queue is FIFO: the
+        deploy strictly precedes any predict we route there)."""
+        for wid in wids:
+            if wid in vr.deployed:
+                continue
+            try:
+                self.router.serving_send(
+                    wid, ("srv_deploy", name, version, vr.blob,
+                          vr.batch_size, vr.latency_target_ms,
+                          version == route.active))
+            except (resilience.ServingReplicaLost,
+                    resilience.WorkerDraining):
+                continue  # leaving anyway; EOF reap will drop it
+            vr.deployed.add(wid)
+
+    def on_worker_spawn(self, wid: int) -> None:
+        """Router callback (post-spawn, router lock released): top the
+        replacement worker up with every deployment + the active pins,
+        restoring the replication factor."""
+        with self._lock:
+            if self._closed:
+                return
+            for name, route in sorted(self._routes.items()):
+                for version, vr in sorted(route.versions.items()):
+                    self._fan_deploy_locked(name, route, version, vr,
+                                            (wid,))
+
+    def retire(self, name: str, version: str) -> None:
+        """Retire one non-active version cluster-wide (evicted and
+        unpinned on every replica; the route forgets it)."""
+        with self._lock:
+            route = self._routes.get(name)
+            if route is None or version not in route.versions:
+                return
+            if version == route.active:
+                raise ValueError(
+                    f"model {name!r} v{version!r} is the active "
+                    "version; cut over before retiring it")
+            vr = route.versions.pop(version)
+            for wid in sorted(vr.deployed):
+                try:
+                    self.router.serving_send(
+                        wid, ("srv_retire", name, version))
+                except (resilience.ServingReplicaLost,
+                        resilience.WorkerDraining):
+                    continue
+
+    # -- the request path ----------------------------------------------------
+
+    def predict(self, name: str, registry: Any, rows: Any, *,
+                deadline_ms: Optional[float] = None,
+                priority: str = executor.PRIORITY_INTERACTIVE,
+                tenant: Optional[str] = None,
+                ctx: Any = None) -> Tuple[Any, str]:
+        """Route one request to a replica and await its answer; returns
+        ``(output, version)``. The version resolves ONCE at admission
+        (under the serving lock, gated by any in-progress cutover) and
+        failover re-admission keeps it — a moved request never switches
+        versions mid-flight."""
+        self._ensure(name, registry)
+        import cloudpickle
+
+        call = _Call("predict", name)
+        call.payload = cloudpickle.dumps(rows)
+        call.priority = priority
+        call.tenant = tenant
+        call.ctx = ctx
+        call.deadline_ms_total = deadline_ms
+        if deadline_ms is not None:
+            call.deadline = time.monotonic() + deadline_ms / 1e3
+        with self._lock:
+            route = self._routes[name]
+        while True:
+            # the admission gate: a cluster-atomic cutover closes the
+            # deployment for its commit window; new predicts wait for
+            # the flip (bounded by their own deadline), never race it
+            if not route.gate.wait(timeout=_GATE_POLL_S):
+                self._check_admission(call)
+                continue
+            with self._lock:
+                if self._closed:
+                    raise resilience.ServingReplicaLost(
+                        "the cluster serving plane is closed")
+                if not route.gate.is_set():
+                    continue  # re-closed between wait and lock
+                version = route.active
+                wid = self._pick_locked(route, version)
+                if wid is None:
+                    raise resilience.ServingReplicaLost(
+                        f"no live replica can serve {name!r} "
+                        f"v{version!r} — every deployed worker is lost "
+                        "or draining")
+                call.version = version
+                call.req_id = next(self._ids)
+                self._pending[call.req_id] = call
+                route.inflight += 1
+                try:
+                    self._dispatch_locked(call, wid)
+                except (resilience.ServingReplicaLost,
+                        resilience.WorkerDraining):
+                    # died/drained between pick and send; try another
+                    self._pending.pop(call.req_id, None)
+                    route.inflight -= 1
+                    continue
+            break
+        blob = self._await(call)
+        return cloudpickle.loads(blob), call.version
+
+    def _check_admission(self, call: _Call) -> None:
+        if (call.deadline is not None
+                and time.monotonic() >= call.deadline):
+            raise resilience.DeadlineExceeded(
+                f"predict on {call.name!r} spent its "
+                f"{call.deadline_ms_total:.0f} ms deadline waiting on "
+                "the cutover gate")
+        if self._closed or self.router.closed:
+            raise resilience.ServingReplicaLost(
+                "cluster router closed while the request waited for "
+                "admission")
+
+    def _pick_locked(self, route: _DeploymentRoute,
+                     version: str) -> Optional[int]:
+        return self._pick_excluding_locked(route, version, ())
+
+    def _pick_excluding_locked(self, route: _DeploymentRoute,
+                               version: str,
+                               exclude: Sequence[int]) -> Optional[int]:
+        """Locality- and load-aware replica choice: HBM-resident
+        workers first, least-in-flight breaks ties; a fully-cold
+        version routes through ONE designated warming worker
+        (cluster-wide single-flight on the cold load)."""
+        vr = route.versions[version]
+        live = [wid for wid in self.router.serving_live_workers()
+                if wid in vr.deployed and wid not in exclude]
+        if telemetry.active() is not None:
+            telemetry.gauge_set(telemetry.M_SERVING_REPLICAS, len(live))
+        if not live:
+            return None
+        resident = [wid for wid in live if wid in vr.resident]
+        if resident:
+            return min(resident, key=lambda w:
+                       (self._wid_inflight.get(w, 0), w))
+        if vr.warming in live:
+            return vr.warming
+        wid = min(live, key=lambda w: (self._wid_inflight.get(w, 0), w))
+        vr.warming = wid
+        return wid
+
+    def _dispatch_locked(self, call: _Call, wid: int) -> None:
+        crash = resilience.should_fire("serving_worker_kill",
+                                       model=call.name,
+                                       request=call.req_id)
+        self.submit_predict(wid, call, tenant=call.tenant, crash=crash)
+        call.worker = wid
+        self._wid_inflight[wid] = self._wid_inflight.get(wid, 0) + 1
+
+    def submit_predict(self, wid: int, call: _Call, *,
+                       tenant: Optional[str],
+                       crash: bool = False) -> None:
+        """Wire-level predict dispatch (the serving-scope tenant lint
+        covers this call site's callers: every dispatch names its
+        tenant). The message carries the REMAINING deadline — a
+        failed-over request re-admits with whatever budget its caller
+        still has, not a fresh one."""
+        self.router.serving_send(
+            wid, ("srv_predict", call.req_id, call.name, call.version,
+                  call.payload, call.remaining_ms(), call.priority,
+                  tenant, call.ctx, crash),
+            req_id=call.req_id)
+
+    def _await(self, call: _Call) -> bytes:
+        while not call.event.wait(_WAIT_POLL_S):
+            if (call.deadline is not None
+                    and time.monotonic() >= call.deadline):
+                self._abandon(call)
+                raise resilience.DeadlineExceeded(
+                    f"predict {call.req_id} on {call.name!r} exceeded "
+                    f"its {call.deadline_ms_total:.0f} ms deadline "
+                    f"({call.failovers} failover(s))")
+        if call.error is not None:
+            raise call.error
+        assert call.blob is not None
+        return call.blob
+
+    def _abandon(self, call: _Call) -> None:
+        """Deadline-expired waiter: withdraw the pending entry so a
+        late answer (or a failover) cannot resurrect the request."""
+        with self._lock:
+            if self._pending.pop(call.req_id, None) is None:
+                return  # resolved concurrently; the answer path won
+            route = self._routes.get(call.name)
+            if route is not None:
+                route.inflight -= 1
+                self._cond.notify_all()
+            if call.worker is not None:
+                n = self._wid_inflight.get(call.worker, 0)
+                if n > 1:
+                    self._wid_inflight[call.worker] = n - 1
+                else:
+                    self._wid_inflight.pop(call.worker, None)
+        if call.worker is not None:
+            self.router.serving_done(call.worker, call.req_id)
+
+    # -- router callbacks (collector thread; router lock released) -----------
+
+    def on_message(self, wid: int, msg: Tuple) -> None:
+        kind = msg[0]
+        if kind == "srv_prepared":
+            _, req_id, ok, err, nbytes = msg
+            self.router.serving_done(wid, req_id)
+            with self._lock:
+                self._worker_bytes[wid] = int(nbytes)
+                call = self._pending.pop(req_id, None)
+                if call is None:
+                    return
+                call.result = (bool(ok), err, int(nbytes))
+                if ok:
+                    self._mark_resident_locked(call.name, call.version,
+                                               wid)
+                call.event.set()
+            return
+        if kind == "srv_ok":
+            _, req_id, blob, meta = msg
+            self._resolve(wid, req_id, blob=blob, meta=meta)
+        elif kind == "srv_err":
+            from sparkdl_tpu.cluster.router import _rebuild_error
+
+            _, req_id, type_name, message, err_kind = msg
+            self._resolve(wid, req_id,
+                          error=_rebuild_error(type_name, message,
+                                               err_kind))
+
+    def _resolve(self, wid: int, req_id: int, blob: Optional[bytes] = None,
+                 meta: Optional[Dict] = None,
+                 error: Optional[BaseException] = None) -> None:
+        self.router.serving_done(wid, req_id)
+        with self._lock:
+            call = self._pending.pop(req_id, None)
+            if call is None:
+                return  # abandoned at its deadline; late answer dropped
+            if error is None:
+                call.blob = blob
+                call.meta = dict(meta or {})
+                self._worker_bytes[wid] = int(
+                    call.meta.get("resident_bytes", 0))
+                self._predicts += 1
+                self._mark_resident_locked(call.name, call.version, wid)
+            else:
+                call.error = error
+            self._finish_locked(call)
+
+    def _mark_resident_locked(self, name: str, version: Optional[str],
+                              wid: int) -> None:
+        route = self._routes.get(name)
+        if route is None or version not in route.versions:
+            return
+        vr = route.versions[version]
+        vr.resident.add(wid)
+        if vr.warming == wid:
+            vr.warming = None
+
+    def _finish_locked(self, call: _Call) -> None:
+        if call.kind == "predict":
+            route = self._routes.get(call.name)
+            if route is not None:
+                route.inflight -= 1
+            if call.worker is not None:
+                n = self._wid_inflight.get(call.worker, 0)
+                if n > 1:
+                    self._wid_inflight[call.worker] = n - 1
+                else:
+                    self._wid_inflight.pop(call.worker, None)
+        call.event.set()
+        self._cond.notify_all()
+
+    def on_worker_lost(self, wid: int, req_ids: Sequence[int]) -> None:
+        """A worker died owing answers for exactly ``req_ids``. Each
+        in-flight predict re-admits to a surviving replica within its
+        caller's remaining deadline (idempotent + journal-free, so the
+        move needs no recovery protocol); a prepare in flight fails the
+        cutover (its waiter rolls back). Exactly-once accounting: this
+        is the ONLY site that records ``serving_failover``, one event
+        per moved request."""
+        moved: List[Tuple[_Call, Optional[int]]] = []
+        with self._lock:
+            self._wid_inflight.pop(wid, None)
+            self._worker_bytes.pop(wid, None)
+            for route in self._routes.values():
+                for vr in route.versions.values():
+                    vr.deployed.discard(wid)
+                    vr.resident.discard(wid)
+                    if vr.warming == wid:
+                        vr.warming = None
+            for req_id in req_ids:
+                call = self._pending.get(req_id)
+                if call is None:
+                    continue
+                if call.kind == "prepare":
+                    call.result = (
+                        False, f"worker {wid} died before acking the "
+                        f"prepare of {call.name!r} v{call.version!r}",
+                        0)
+                    self._pending.pop(req_id, None)
+                    self._finish_locked(call)
+                    continue
+                err = self._readmit_locked(call, wid)
+                if err is None:
+                    moved.append((call, call.worker))
+                else:
+                    call.error = err
+                    self._pending.pop(req_id, None)
+                    self._finish_locked(call)
+        for call, to_wid in moved:
+            health.record(health.SERVING_FAILOVER, model=call.name,
+                          version=call.version, request=call.req_id,
+                          from_worker=wid, to_worker=to_wid)
+            if telemetry.active() is not None:
+                telemetry.count(telemetry.M_SERVING_FAILOVER)
+
+    def _readmit_locked(self, call: _Call,
+                        dead_wid: int) -> Optional[BaseException]:
+        """Re-dispatch one orphaned predict; returns the error that
+        fails it instead, or None when it moved."""
+        from sparkdl_tpu.engine.dataframe import EngineConfig
+
+        limit = max(0, int(EngineConfig.serving_failover_max))
+        if call.failovers >= limit:
+            return resilience.ServingReplicaLost(
+                f"predict {call.req_id} on {call.name!r} "
+                f"v{call.version!r} lost its worker "
+                f"{call.failovers + 1} time(s); the failover budget "
+                f"({limit}) is spent")
+        if (call.deadline is not None
+                and time.monotonic() >= call.deadline):
+            return resilience.DeadlineExceeded(
+                f"predict {call.req_id} on {call.name!r} lost worker "
+                f"{dead_wid} with no deadline budget left to re-admit")
+        route = self._routes.get(call.name)
+        if route is None or call.version not in route.versions:
+            return resilience.ServingReplicaLost(
+                f"predict {call.req_id}: deployment {call.name!r} "
+                f"v{call.version!r} is no longer routed")
+        wid = self._pick_excluding_locked(route, call.version,
+                                          (dead_wid,))
+        if wid is None:
+            return resilience.ServingReplicaLost(
+                f"predict {call.req_id} on {call.name!r} "
+                f"v{call.version!r}: worker {dead_wid} died and no "
+                "surviving replica holds the version")
+        try:
+            self._dispatch_locked(call, wid)
+        except (resilience.ServingReplicaLost,
+                resilience.WorkerDraining) as e:
+            return e
+        call.failovers += 1
+        # sparkdl: allow(unguarded-shared-write): caller holds self._lock (the _locked-suffix contract)
+        self._failovers += 1
+        self._moved.append(call.req_id)
+        return None
+
+    def on_close(self) -> None:
+        """Router shutdown: fail every orphaned exchange (their waiters
+        would otherwise poll until their deadlines) and open every gate
+        so blocked admissions observe the closed state."""
+        with self._lock:
+            self._closed = True
+            pending = list(self._pending.values())
+            self._pending.clear()
+            for call in pending:
+                if call.kind == "prepare":
+                    call.result = (False, "cluster router closed "
+                                   "mid-prepare", 0)
+                else:
+                    call.error = resilience.ServingReplicaLost(
+                        "cluster router closed while the request was "
+                        "in flight")
+                self._finish_locked(call)
+            for route in self._routes.values():
+                route.gate.set()
+
+    # -- cluster-atomic hot swap ---------------------------------------------
+
+    def cutover(self, name: str, registry: Any, version: str, *,
+                timeout_s: float = _CUTOVER_TIMEOUT_S) -> str:
+        """Two-phase cluster-atomic hot swap; returns the previous
+        active version.
+
+        *Prepare*: every live replica loads ``version`` (pinned) and
+        acks residency. Any nack, death, or timeout aborts: the new
+        version unpins everywhere it prepared
+        (``serving_prepare_failed`` recorded) and :class:`CutoverFailed`
+        raises with the old version still serving everywhere.
+
+        *Commit*: the deployment's admission gate closes, in-flight
+        predicts drain, ONE pointer flips (plus the coordinator
+        registry's, which records ``serving_cutover`` and moves its
+        pins), worker pins move, the gate reopens. The last old-version
+        response strictly precedes the first new-version admission —
+        no caller pair can ever observe mixed versions."""
+        self._ensure(name, registry, adopt=False)
+        with self._lock:
+            route = self._routes.get(name)
+            if route is None or version not in route.versions:
+                raise KeyError(
+                    f"model {name!r} has no version {version!r} to cut "
+                    "over to")
+            swap_lock = route.swap_lock
+        with swap_lock:
+            with self._lock:
+                prev = route.active
+                if prev == version:
+                    return prev
+                vr = route.versions[version]
+                targets = [wid for wid in
+                           self.router.serving_live_workers()
+                           if wid in vr.deployed]
+                if not targets:
+                    raise CutoverFailed(
+                        f"no live replica holds {name!r} v{version!r} "
+                        "to prepare")
+                calls: List[_Call] = []
+                for wid in targets:
+                    call = _Call("prepare", name)
+                    call.version = version
+                    call.req_id = next(self._ids)
+                    call.worker = wid
+                    self._pending[call.req_id] = call
+                    try:
+                        self.router.serving_send(
+                            wid, ("srv_prepare", call.req_id, name,
+                                  version), req_id=call.req_id)
+                    except (resilience.ServingReplicaLost,
+                            resilience.WorkerDraining):
+                        # leaving anyway — not serving either version
+                        self._pending.pop(call.req_id, None)
+                        continue
+                    calls.append(call)
+            failure: Optional[str] = None
+            ack_deadline = time.monotonic() + timeout_s
+            for call in calls:
+                remaining = max(0.0, ack_deadline - time.monotonic())
+                if not call.event.wait(remaining):
+                    with self._lock:
+                        self._pending.pop(call.req_id, None)
+                    self.router.serving_done(call.worker, call.req_id)
+                    failure = (f"worker {call.worker} did not ack the "
+                               f"prepare within {timeout_s:.0f}s")
+                    break
+                ok, err, _ = call.result
+                if not ok:
+                    failure = err
+                    break
+            if failure is not None:
+                self._rollback_prepare(name, version, targets)
+                health.record(health.SERVING_PREPARE_FAILED, model=name,
+                              version=version, error=failure)
+                with self._lock:
+                    self._prepare_failures += 1
+                raise CutoverFailed(
+                    f"cluster cutover of {name!r} to v{version!r} "
+                    f"failed in prepare — rolled back, v{prev!r} still "
+                    f"serving everywhere: {failure}")
+            # COMMIT: close admission, drain, flip once, move pins
+            drain_deadline = time.monotonic() + timeout_s
+            with self._lock:
+                route.gate.clear()
+                try:
+                    while route.inflight > 0:
+                        # sparkdl: allow(wait-holding-lock): the per-deployment swap lock is held by design — it serializes cutovers; the wakers (predict resolution/failure paths) take only the serving lock, never the swap lock
+                        if not self._cond.wait(timeout=_WAIT_POLL_S):
+                            if time.monotonic() >= drain_deadline:
+                                raise CutoverFailed(
+                                    f"cluster cutover of {name!r} to "
+                                    f"v{version!r}: {route.inflight} "
+                                    "in-flight predict(s) did not "
+                                    f"drain within {timeout_s:.0f}s — "
+                                    f"aborted, v{prev!r} still active")
+                    route.previous = prev
+                    route.active = version
+                    for wid in sorted(vr.deployed):
+                        try:
+                            self.router.serving_send(
+                                wid, ("srv_pin", name, prev, False))
+                        except (resilience.ServingReplicaLost,
+                                resilience.WorkerDraining):
+                            continue
+                finally:
+                    route.gate.set()
+                self._cutovers += 1
+        # the coordinator registry flips AFTER the cluster committed
+        # (records serving_cutover, moves coordinator-side pins); a
+        # no-op when _ensure is adopting a flip the registry already
+        # made
+        if registry.active_version(name) != version:
+            registry.cutover(name, version)
+        return prev
+
+    def _rollback_prepare(self, name: str, version: str,
+                          targets: Sequence[int]) -> None:
+        """Undo a failed prepare: the new version unpins (evictable
+        again) on every targeted worker; nothing was flipped, so the
+        old version's pins and the active pointer are untouched."""
+        with self._lock:
+            for wid in targets:
+                try:
+                    self.router.serving_send(
+                        wid, ("srv_pin", name, version, False))
+                except (resilience.ServingReplicaLost,
+                        resilience.WorkerDraining):
+                    continue
+
+    def rollback(self, name: str, registry: Any) -> str:
+        """Cut back to the previously-active version, cluster-
+        atomically (the same two-phase primitive aimed backwards)."""
+        with self._lock:
+            route = self._routes.get(name)
+            target = route.previous if route is not None else None
+        if target is None:
+            raise ValueError(
+                f"model {name!r} has no previous active version to "
+                "roll back to")
+        return self.cutover(name, registry, target)
+
+    # -- introspection -------------------------------------------------------
+
+    def status(self) -> Dict[str, Any]:
+        """Per-deployment replica map — worker name -> versions
+        deployed/resident, last-reported resident bytes, in-flight
+        depth — surfaced through ``ModelServer.status()["cluster"]``
+        and the exporter snapshot's ``serving`` section."""
+        out: Dict[str, Any] = {}
+        with self._lock:
+            for name, route in sorted(self._routes.items()):
+                wids: Set[int] = set()
+                for vr in route.versions.values():
+                    wids |= vr.deployed
+                replicas = {}
+                for wid in sorted(wids):
+                    wname = self.router.serving_worker_name(wid)
+                    replicas[wname] = {
+                        "versions": sorted(
+                            v for v, vr in route.versions.items()
+                            if wid in vr.deployed),
+                        "resident": sorted(
+                            v for v, vr in route.versions.items()
+                            if wid in vr.resident),
+                        "resident_bytes": self._worker_bytes.get(wid, 0),
+                        "inflight": self._wid_inflight.get(wid, 0),
+                    }
+                out[name] = {"active": route.active,
+                             "inflight": route.inflight,
+                             "replicas": replicas}
+        return out
+
+    def report_section(self) -> Dict[str, Any]:
+        """The coordinator-side ``serving.router`` block of the merged
+        run report: routing totals, the exactly-once failover ledger,
+        and the final replica topology."""
+        with self._lock:
+            return {
+                "predicts": self._predicts,
+                "failovers": self._failovers,
+                "moved_requests": list(self._moved),
+                "cutovers": self._cutovers,
+                "prepare_failures": self._prepare_failures,
+                "deployments": {
+                    name: {
+                        "active": route.active,
+                        "versions": {
+                            v: {"deployed": sorted(vr.deployed),
+                                "resident": sorted(vr.resident)}
+                            for v, vr in sorted(route.versions.items())
+                        },
+                    }
+                    for name, route in sorted(self._routes.items())},
+            }
+
+
+# =============================================================================
+# Process-wide wiring
+# =============================================================================
+
+_mod_lock = threading.Lock()
+_instance: Optional[ClusterServingRouter] = None
+
+
+def maybe_cluster_serving() -> Optional[ClusterServingRouter]:
+    """The process-wide serving router bound to the process-wide
+    :func:`~sparkdl_tpu.cluster.router.maybe_router` instance (rebuilt
+    whenever the underlying router was rebuilt), or None when no
+    cluster is armed. Callers (``ModelServer._cluster``) check the
+    knobs BEFORE importing this module."""
+    from sparkdl_tpu.cluster import router as cluster_router
+
+    router = cluster_router.maybe_router()
+    if router is None:
+        return None
+    global _instance
+    with _mod_lock:
+        inst = _instance
+        if inst is None or inst.router is not router or inst.closed:
+            inst = ClusterServingRouter(router)
+            _instance = inst
+        return inst
+
+
+def exporter_status() -> Optional[Dict[str, Any]]:
+    """The live replica map for ``SnapshotExporter`` (None when no
+    serving router is active — the exporter omits the section)."""
+    inst = _instance
+    if inst is None or inst.closed or inst.router.closed:
+        return None
+    return inst.status()
+
+
+def reset() -> None:
+    """Drop the process-wide instance (tests; the underlying router's
+    own shutdown already failed any in-flight exchanges)."""
+    global _instance
+    with _mod_lock:
+        _instance = None

@@ -65,6 +65,19 @@ class Deployment:
             return None
         return self.latency_target_ms / 1e3
 
+    def resident_bytes(self) -> int:
+        """Bytes of this version's weights if currently materialized,
+        else 0 — never triggers a load (the replica-map introspection
+        path must stay cheap)."""
+        if self._residency is not None:
+            return self._residency.resident_bytes_for(self.name,
+                                                      self.version)
+        model = self._model
+        if model is None:
+            return 0
+        return (int(model.weight_bytes())
+                if hasattr(model, "weight_bytes") else 0)
+
     def model(self) -> Any:
         """The materialized ModelFunction (loading it on first use)."""
         if self._residency is not None:
@@ -252,6 +265,29 @@ class ModelRegistry:
         transformers too: each transform call re-resolves)."""
         active, _ = self.resolve(name)
         return active.model()
+
+    def deployment(self, name: str,
+                   version: Optional[str] = None) -> Deployment:
+        """The :class:`Deployment` record for (name, version) — the
+        ACTIVE version when ``version`` is None — WITHOUT
+        :meth:`resolve`'s shadow-accumulator side effect. The cluster
+        serving router resolves versions itself (shadow mirroring is a
+        single-process feature), and admission checks must not consume
+        shadow slots."""
+        with self._lock:
+            entry = self._require_locked(name)
+            v = entry.active if version is None else version
+            if v not in entry.versions:
+                raise KeyError(
+                    f"model {name!r} has no version {v!r}; deployed: "
+                    f"{sorted(entry.versions)}")
+            return entry.versions[v]
+
+    def deployments(self, name: str) -> Dict[str, Deployment]:
+        """Every deployed version of ``name`` (a snapshot copy) — the
+        cluster serving router's replica fan-out source."""
+        with self._lock:
+            return dict(self._require_locked(name).versions)
 
     # -- introspection -------------------------------------------------------
 

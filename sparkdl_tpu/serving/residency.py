@@ -231,6 +231,15 @@ class ResidencyManager:
             return sum(r.bytes for r in self._residents.values()
                        if r.model is not None)
 
+    def resident_bytes_for(self, name: str, version: str) -> int:
+        """Bytes one (model, version) currently holds resident (0 when
+        cold, evicted, or unregistered) — the per-replica accounting the
+        cluster serving status map reports."""
+        with self._cond:
+            row = self._residents.get((name, version))
+            return (row.bytes if row is not None
+                    and row.model is not None else 0)
+
     def is_resident(self, name: str, version: str) -> bool:
         with self._cond:
             row = self._residents.get((name, version))

@@ -58,6 +58,40 @@ class ServingOverloaded(RuntimeError):
     further over. Clients treat this as retry-with-backoff."""
 
 
+def stage_rows(dep: Any, rows: Any):
+    """Coerce a request payload to ``(batch, single)``: a single row
+    (rank = the model's element rank) gains a batch dim here and loses
+    it again in the response. Module-level because BOTH serving planes
+    stage identically — the in-process ModelServer and the cluster
+    worker's replica (``serving/cluster.py``) — and the chaos proof
+    compares their outputs bit-for-bit."""
+    if isinstance(rows, dict):
+        # multi-input models: the payload is already a named batch
+        # tree; ModelFunction.stage_inputs (inside execute) owns it
+        return rows, False
+    batch = np.asarray(rows)
+    spec = getattr(dep.model(), "input_spec", None)
+    element_shape = getattr(spec, "element_shape", None)
+    single = (element_shape is not None
+              and batch.ndim == len(element_shape))
+    if single:
+        batch = batch[None]
+    if batch.shape[0] == 0:
+        raise ValueError("predict() needs at least one row")
+    return batch, single
+
+
+def target_window_ms(dep: Any) -> Optional[float]:
+    """The deployment's coalesce-window cap derived from its latency
+    target (None = the executor's adaptive window) — shared by both
+    serving planes so a replicated deployment batches exactly like the
+    single-process path."""
+    if dep.latency_target_ms is None:
+        return None
+    return min(dep.latency_target_ms * _TARGET_WINDOW_FRACTION,
+               _TARGET_WINDOW_MAX_MS)
+
+
 class PredictResult:
     """One answered request: the output, WHICH version answered, and
     the end-to-end latency (shadow comparison time included when this
@@ -124,6 +158,12 @@ class ModelServer:
         queue-wait series + shed attribution (None resolves through the
         ambient ``executor.tenant_scope`` / EngineConfig default)."""
         t0 = time.monotonic()
+        cluster = self._cluster()
+        if cluster is not None:
+            return self._predict_cluster(cluster, model, rows,
+                                         deadline_ms=deadline_ms,
+                                         priority=priority,
+                                         tenant=tenant, t0=t0)
         active, shadow = self.registry.resolve(model)
         # shed BEFORE paying for staging / cold load
         self._admit(active, tenant=tenant)
@@ -161,6 +201,80 @@ class ModelServer:
         return PredictResult(out, model, active.version, latency_s,
                              shadowed)
 
+    # -- the cluster serving plane -------------------------------------------
+
+    @staticmethod
+    def _cluster() -> Optional[Any]:
+        """The cluster serving router iff the knobs arm it. Resolved
+        through ``sys.modules`` so a process that never configured the
+        engine — or left ``cluster_workers=0`` / ``serving_cluster``
+        off — keeps the single-process request path byte-identical and
+        NEVER imports ``serving/cluster.py``."""
+        import sys
+
+        eng = sys.modules.get("sparkdl_tpu.engine.dataframe")
+        if eng is None:
+            return None
+        cfg = eng.EngineConfig
+        if not (cfg.serving_cluster and cfg.cluster_workers):
+            return None
+        from sparkdl_tpu.serving import cluster as serving_cluster
+
+        return serving_cluster.maybe_cluster_serving()
+
+    def _predict_cluster(self, cluster: Any, model: str, rows: Any, *,
+                         deadline_ms: Optional[float], priority: str,
+                         tenant: Optional[str], t0: float
+                         ) -> PredictResult:
+        """Cluster-routed predict: version resolution, replica routing,
+        failover re-admission and the cutover gate live in
+        ``serving/cluster.py``; SLO-aware admission and the in-flight
+        gauge stay here. Shadow mirroring is single-process-only (in
+        cluster mode a candidate replicates dark and cuts over
+        cluster-atomically instead). The latency observation carries
+        the request's span context as an exemplar, so a failed-over
+        request's trace lands in the tail exemplars — the report NAMES
+        the requests a worker death touched."""
+        active = self.registry.deployment(model)
+        self._admit(active, tenant=tenant)
+        ctx = telemetry.current_context()
+        self._note_inflight(1)
+        try:
+            out, version = cluster.predict(
+                model, self.registry, rows, deadline_ms=deadline_ms,
+                priority=priority, tenant=tenant, ctx=ctx)
+        finally:
+            self._note_inflight(-1)
+        latency_s = time.monotonic() - t0
+        if telemetry.active() is not None:
+            telemetry.observe(telemetry.M_SERVING_REQUEST_S, latency_s,
+                              exemplar=ctx)
+            telemetry.observe(telemetry.serving_request_metric(model),
+                              latency_s, exemplar=ctx)
+        return PredictResult(out, model, version, latency_s, False)
+
+    def cutover(self, model: str, version: str) -> str:
+        """Hot-swap ``model`` to ``version``; returns the previous
+        active version. Single-process: the registry's atomic pointer
+        flip. Cluster mode: the two-phase cluster-atomic cutover —
+        every replica loads and acks the new version (prepare), then
+        ONE router flip (commit), so no window exists where two callers
+        get different versions; a failed prepare rolls back with the
+        old version still serving everywhere."""
+        cluster = self._cluster()
+        if cluster is not None:
+            return cluster.cutover(model, self.registry, version)
+        return self.registry.cutover(model, version)
+
+    def rollback(self, model: str) -> str:
+        """Cut back to the previous active version — the same primitive
+        as :meth:`cutover`, aimed backwards, cluster-atomic when the
+        cluster serving plane is armed."""
+        cluster = self._cluster()
+        if cluster is not None:
+            return cluster.rollback(model, self.registry)
+        return self.registry.rollback(model)
+
     # -- SLO-aware admission -------------------------------------------------
 
     def _admit(self, dep: Any, tenant: Optional[str] = None) -> None:
@@ -186,10 +300,7 @@ class ModelServer:
                 f"of its {target_s:.3f}s latency target")
 
     def _window_ms(self, dep: Any) -> Optional[float]:
-        if dep.latency_target_ms is None:
-            return None  # adaptive window (executor's latency EWMA)
-        return min(dep.latency_target_ms * _TARGET_WINDOW_FRACTION,
-                   _TARGET_WINDOW_MAX_MS)
+        return target_window_ms(dep)
 
     # -- shadow traffic ------------------------------------------------------
 
@@ -237,23 +348,7 @@ class ModelServer:
     # -- plumbing ------------------------------------------------------------
 
     def _stage_rows(self, dep: Any, rows: Any):
-        """Coerce the request payload to a batch array; a single row
-        (rank = the model's element rank) gains a batch dim here and
-        loses it again in the response."""
-        if isinstance(rows, dict):
-            # multi-input models: the payload is already a named batch
-            # tree; ModelFunction.stage_inputs (inside execute) owns it
-            return rows, False
-        batch = np.asarray(rows)
-        spec = getattr(dep.model(), "input_spec", None)
-        element_shape = getattr(spec, "element_shape", None)
-        single = (element_shape is not None
-                  and batch.ndim == len(element_shape))
-        if single:
-            batch = batch[None]
-        if batch.shape[0] == 0:
-            raise ValueError("predict() needs at least one row")
-        return batch, single
+        return stage_rows(dep, rows)
 
     def _note_inflight(self, delta: int) -> None:
         with self._lock:
@@ -265,8 +360,14 @@ class ModelServer:
     def status(self) -> Dict[str, Any]:
         with self._lock:
             inflight = self._inflight
-        return {"inflight": inflight, "admission": self._admission,
-                "models": self.registry.names()}
+        out = {"inflight": inflight, "admission": self._admission,
+               "models": self.registry.names()}
+        cluster = self._cluster()
+        if cluster is not None:
+            # per-deployment replica map: worker -> versions deployed /
+            # resident, last-reported resident bytes, in-flight depth
+            out["cluster"] = cluster.status()
+        return out
 
 
 def _max_divergence(a: Any, b: Any) -> float:

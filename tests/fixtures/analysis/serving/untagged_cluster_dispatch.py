@@ -1,0 +1,9 @@
+"""Seeded violation: the cluster serving router's wire dispatch called
+without a tenant tag (tenant-tag; ``submit_predict`` is a serving-plane
+dispatch entry point — a routed predict that drops the tag burns the
+default lane's fair-queueing quota on the WORKER, invisibly to the
+coordinator's per-tenant series)."""
+
+
+def failover_readmit(router, wid, call):
+    return router.submit_predict(wid, call, crash=False)

@@ -354,6 +354,23 @@ def test_registry_targets_feed_serving_rules():
     assert any(r.name == "serving_request_p99_clf" for r in rules)
 
 
+def test_cluster_serving_rules_add_failover_rate():
+    from sparkdl_tpu.core import health
+
+    rules = slo.cluster_serving_rules({"clf": 0.25})
+    by_name = {r.name: r for r in rules}
+    # superset of the single-process plane's rules...
+    for name in ("serving_request_p99", "serving_shed_rate",
+                 "serving_request_p99_clf"):
+        assert name in by_name
+    # ...plus the sustained-failover watchdog on the health mirror
+    fo = by_name["serving_failover_rate"]
+    assert fo.metric == telemetry.HEALTH_METRIC_PREFIX \
+        + health.SERVING_FAILOVER
+    assert fo.stat == "rate_per_s"
+    assert fo.threshold == slo.DEFAULT_SERVING_FAILOVER_RATE_PER_S
+
+
 # ---------------------------------------------------------------------------
 # ml/udf resolve through the registry
 # ---------------------------------------------------------------------------

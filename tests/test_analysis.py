@@ -80,6 +80,7 @@ EXPECTED_FIXTURE_RULES = {
     "ml/precision_donation.py": {"executor-choke-point"},
     "serving/hot_path.py": {"executor-choke-point"},
     "serving/untagged_execute.py": {"tenant-tag"},
+    "serving/untagged_cluster_dispatch.py": {"tenant-tag"},
     "cluster/worker_loop.py": {"executor-choke-point",
                                "thread-lifecycle"},
     "trainer_fetch.py": {"blocking-fetch-in-fit"},
