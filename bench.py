@@ -1421,6 +1421,16 @@ def bench_streaming_fit(n_images=768):
     finally:
         EngineConfig.restore(saved)
         decode_pool.shutdown()
+    def device_rate_fraction(rate, run_phases):
+        """e2e rate / device-only rate — ROADMAP item-1's trajectory
+        metric (1.0 = the device never waits on host ETL). The phase
+        window after reset_phase_stats covers two fit(3) runs, so the
+        train_step phase saw 6 * n_images images."""
+        ts = run_phases.get("sparkdl.train_step")
+        if not ts or rate <= 0:
+            return None
+        return round(rate / (6 * n_images / ts), 4)
+
     tel_summary = {
         "steps_per_sec": _hist_summary(snap, telemetry.M_STEPS_PER_SEC),
         "step_time_s": _hist_summary(snap, telemetry.M_STEP_TIME_S),
@@ -1428,6 +1438,7 @@ def bench_streaming_fit(n_images=768):
                                           telemetry.M_PREFETCH_STALL_S),
         "padding_waste": snap["gauges"].get(telemetry.M_PADDING_WASTE),
         "overlap": {k: round(v, 4) for k, v in overlap.items()},
+        "device_rate_fraction": device_rate_fraction(sips, phases),
     }
     pooled = {
         "images_per_sec": round(psips, 2),
@@ -1437,6 +1448,7 @@ def bench_streaming_fit(n_images=768):
         "overlap_ratio": round(poverlap["overlap_ratio"], 4),
         "speedup": (round(psips / sips, 4) if sips > 0 and psips > 0
                     else None),
+        "device_rate_fraction": device_rate_fraction(psips, pphases),
     }
     # the invalid-marginal marker (-1.0) propagates as the headline value
     # so a tunnel-noise round can't poison the next vs_baseline
@@ -1687,6 +1699,7 @@ def main():
                  "train)", sips, "images/sec", phases=phases,
                  host_wait_s=round(overlap["host_wait_s"], 3),
                  overlap_ratio=round(overlap["overlap_ratio"], 4),
+                 device_rate_fraction=fit_tel["device_rate_fraction"],
                  telemetry=fit_tel, pooled=fit_pooled)
             st, sp = bench_train_step("MobileNetV2", 64)
             st16, sp16 = bench_train_step("MobileNetV2", 64,

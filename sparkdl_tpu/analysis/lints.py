@@ -625,3 +625,76 @@ class TenantTagRule(Rule):
             "thread the caller's tenant tag (tenant=None to adopt the "
             "ambient tenant_scope)")
             for line in untagged_execute_calls(src.tree)]
+
+
+# ---------------------------------------------------------------------------
+# columnar-hot-path (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+#: The data-plane modules where image/tensor columns flow decode →
+#: device. param/ (loader plumbing) and serving/ (row-level requests)
+#: are out of scope; their payloads are single rows by design.
+COLUMNAR_SCOPES = ("image", "ml", "engine")
+
+#: Per-row wrappers whose appearance inside a loop/comprehension means
+#: an image or tensor column is being rebuilt one Python dict at a time.
+_PER_ROW_IMAGE_WRAPPERS = ("imageArrayToStruct",)
+
+
+def per_row_column_hops(tree: ast.AST) -> List[Tuple[int, str]]:
+    """(lineno, reason) for per-row hops over columnar data: any
+    ``.to_pylist()`` call, and any per-row image-struct construction
+    (``imageArrayToStruct``) under a loop or comprehension."""
+    out = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "to_pylist":
+            out.add((node.lineno,
+                     ".to_pylist() materializes the column as per-row "
+                     "Python objects"))
+    for loop in ast.walk(tree):
+        if not isinstance(loop, (ast.For, ast.ListComp, ast.SetComp,
+                                 ast.DictComp, ast.GeneratorExp)):
+            continue
+        for sub in ast.walk(loop):
+            if not isinstance(sub, ast.Call):
+                continue
+            f = sub.func
+            name = (f.attr if isinstance(f, ast.Attribute)
+                    else f.id if isinstance(f, ast.Name) else None)
+            if name in _PER_ROW_IMAGE_WRAPPERS:
+                out.add((sub.lineno,
+                         f"per-row {name}() in a loop rebuilds the "
+                         "image column one Python dict at a time"))
+    return sorted(out)
+
+
+@register
+class ColumnarHotPathRule(Rule):
+    id = "columnar-hot-path"
+    title = "image/tensor columns must stay columnar on the data plane"
+    rationale = (
+        "The ingest spine is zero-copy columnar end to end (docs/PERF.md "
+        "'Columnar data plane'): decode-pool segments become Arrow "
+        "binary children become device uint8 batches with no per-row "
+        "Python hop. A `.to_pylist()` or loop of `imageArrayToStruct` on "
+        "that route silently reintroduces the per-row dict "
+        "materialization BENCH_r05 measured at two orders of magnitude "
+        "of lost throughput — and no test fails, only the trajectory. "
+        "String/URI/label columns and ragged-batch fallbacks are "
+        "legitimate: suppress those sites with a reason.")
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        parts = set(pathlib.PurePath(src.rel).parts)
+        if not parts & set(COLUMNAR_SCOPES):
+            return []
+        return [self.finding(
+            src, line,
+            f"{reason} — on the columnar data plane "
+            "(image/, ml/, engine/) use the zero-copy views "
+            "(arrowImageBatch, list_column_to_numpy, to_numpy with "
+            "validity masks) or suppress with the ragged/string-column "
+            "justification")
+            for line, reason in per_row_column_hops(src.tree)]

@@ -187,9 +187,15 @@ def _pack_result(arrays: Sequence[Optional[np.ndarray]],
 
 
 def _adopt_result(meta: Dict[str, Any]) -> List[Optional[np.ndarray]]:
-    """Parent-side: attach the chunk's segment, copy every array out,
-    then close AND unlink — the segment's life ends here regardless of
-    whether a waiter still wants the arrays."""
+    """Parent-side: attach the chunk's segment, copy the packed region
+    out in ONE memcpy, then close AND unlink — the segment's life ends
+    here regardless of whether a waiter still wants the arrays.
+
+    The returned arrays are consecutive views over that single flat
+    uint8 buffer (``_pack_result`` packs them gap-free), which the
+    columnar plane (``imageIO.imageArraysToStructColumn``) detects and
+    wraps zero-copy into an Arrow binary child — so a decoded chunk
+    costs exactly one copy between shm and the device transfer."""
     shapes = meta["shapes"]
     arrays: List[Optional[np.ndarray]] = [None] * len(shapes)
     name = meta.get("shm")
@@ -197,12 +203,16 @@ def _adopt_result(meta: Dict[str, Any]) -> List[Optional[np.ndarray]]:
         return arrays
     seg = shared_memory.SharedMemory(name=name)
     try:
+        end = 0
+        for shape, off in zip(shapes, meta["offsets"]):
+            if shape is not None:
+                end = max(end, off + int(np.prod(shape)))
+        flat = np.frombuffer(seg.buf, dtype=np.uint8, count=end).copy()
         for i, shape in enumerate(shapes):
             if shape is None:
                 continue
-            view = np.ndarray(shape, dtype=np.uint8, buffer=seg.buf,
-                              offset=meta["offsets"][i])
-            arrays[i] = np.array(view, copy=True)
+            off = meta["offsets"][i]
+            arrays[i] = flat[off:off + int(np.prod(shape))].reshape(shape)
     finally:
         seg.close()
         try:

@@ -1487,6 +1487,22 @@ def reset() -> DeviceExecutor:
     return _service
 
 
+def _tree_leaves(obj: Any) -> list:
+    """Flatten a staged payload (array / tuple / list / dict pytree)
+    without importing jax on the counting path."""
+    if isinstance(obj, dict):
+        out = []
+        for v in obj.values():
+            out.extend(_tree_leaves(v))
+        return out
+    if isinstance(obj, (tuple, list)):
+        out = []
+        for v in obj:
+            out.extend(_tree_leaves(v))
+        return out
+    return [obj]
+
+
 def execute(model: Any, array: Any, *, batch_size: int = 64,
             mesh: Any = None,
             retry_policy: Optional[resilience.RetryPolicy] = None,
@@ -1528,6 +1544,18 @@ def execute(model: Any, array: Any, *, batch_size: int = 64,
     from sparkdl_tpu.engine.dataframe import EngineConfig
 
     EngineConfig.validate()  # read-time knob validation (clear ValueError)
+    if telemetry.active() is not None:
+        # bytes as staged by the HOST: on the columnar plane this is raw
+        # uint8 pixels — the counter is the observable that "host ships
+        # uint8 only" (docs/PERF.md "Columnar data plane"); a float32
+        # staging regression shows up as a 4x jump per image.
+        try:
+            payload = sum(int(getattr(leaf, "nbytes", 0))
+                          for leaf in _tree_leaves(array))
+        except Exception:  # exotic payloads never break the data plane
+            payload = 0
+        if payload:
+            telemetry.count(telemetry.M_STAGED_BYTES, payload)
     # Precision and donation are decided HERE, once, from EngineConfig —
     # never per call site (the choke-point lint flags transformers that
     # try). "float32" leaves the model untouched: bit-identical escape

@@ -485,6 +485,13 @@ class ModelFunction:
         (``buildSpImageConverter``, SURVEY.md §3.2) — device-side, no
         antialias; ``jax.image.resize`` with ``antialias=False`` reproduces
         that. Memoized per (src, target) pair (one XLA program each).
+
+        This is the fused-preprocess entry (docs/PERF.md "Columnar data
+        plane"): under ``EngineConfig.fused_preprocess`` the transformer
+        ships raw uint8 at source size and composes this in front of the
+        normalize mode and forward pass, so cast/resize/normalize/forward
+        are one compiled program (the cast below is exact for 0-255
+        uint8, so fp32 results match host-f32 staging bit for bit).
         """
         target = (tuple(target_size) if target_size is not None
                   else self.input_spec.spatial_size())

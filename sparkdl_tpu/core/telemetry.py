@@ -171,6 +171,11 @@ M_EXECUTOR_OCCUPANCY = "sparkdl.executor.occupancy"    # gauge (in-flight)
 # gauges below are the executor's own instantaneous state.
 M_EXECUTOR_QUEUE_DEPTH = "sparkdl.executor.queue_depth"  # gauge (queued reqs)
 M_EXECUTOR_SHED_RATE = "sparkdl.executor.shed_rate"    # gauge (shed fraction)
+# Columnar data plane (docs/PERF.md "Columnar data plane"): bytes handed
+# to the executor per execute() call, as staged on the host. On the
+# columnar path this is raw uint8 pixels — the counter is how bench and
+# tests assert "host ships uint8 only" (a f32 regression quadruples it).
+M_STAGED_BYTES = "sparkdl.executor.staged_bytes"       # counter
 # Parallel host decode pool (core/decode_pool.py, docs/PERF.md "Parallel
 # host ingest"):
 M_DECODE_POOL_DEPTH = "sparkdl.decode_pool.queue_depth"    # gauge (chunks)
@@ -250,6 +255,7 @@ CANONICAL_METRIC_KINDS: Dict[str, str] = {
     M_EXECUTOR_OCCUPANCY: "gauge",
     M_EXECUTOR_QUEUE_DEPTH: "gauge",
     M_EXECUTOR_SHED_RATE: "gauge",
+    M_STAGED_BYTES: "counter",
     M_DECODE_POOL_DEPTH: "gauge",
     M_DECODE_POOL_BUSY: "gauge",
     M_DECODE_POOL_DECODE_S: "histogram",

@@ -143,6 +143,24 @@ class EngineConfig:
     # Max in-flight decode chunks pool-wide (backpressure bound on host
     # memory for decoded-but-unconsumed pixels); None = 2 * decode_workers.
     decode_pool_inflight: Optional[int] = None
+    # -- zero-copy columnar image plane (image/imageIO.py, docs/PERF.md
+    # "Columnar data plane") ---------------------------------------------------
+    # Build image-struct columns COLUMNAR: a uniform decoded batch packs
+    # into ONE contiguous values buffer wrapped zero-copy as the Arrow
+    # column's binary child (imageIO.imageArraysToStructColumn — no
+    # per-row dict, no per-row tobytes), which arrowImageBatch views
+    # back as one NHWC batch downstream, again without copying. The
+    # column's logical values are identical to the per-row builder's;
+    # ragged batches fall back to it, and False restores it everywhere.
+    columnar_images: bool = True
+    # Fuse resize into the device program: the uniform fast path ships
+    # raw HWC uint8 at SOURCE size and the compiled fn runs cast →
+    # resize → normalize → forward as one XLA program
+    # (ModelFunction.resized; composes with inference_precision and
+    # donation at the executor choke point). False restores the
+    # measured r3 host-resize downscale policy
+    # (ml/image_transformer._resize_uniform_batch).
+    fused_preprocess: bool = True
     # -- durable job recovery (core/durability.py, docs/RESILIENCE.md
     # "Durable recovery") ------------------------------------------------------
     # Root directory for write-ahead partition journals + atomic spills.
@@ -276,7 +294,8 @@ class EngineConfig:
                  cls.executor_breaker_window_s,
                  cls.executor_breaker_cooldown_s,
                  cls.executor_idle_retire_s, cls.decode_workers,
-                 cls.decode_pool_inflight, cls.cluster_workers,
+                 cls.decode_pool_inflight, cls.columnar_images,
+                 cls.fused_preprocess, cls.cluster_workers,
                  cls.cluster_inflight_partitions, cls.cluster_autoscale,
                  cls.cluster_min_workers, cls.cluster_max_workers,
                  cls.autoscale_window_s, cls.autoscale_cooldown_s,
@@ -364,6 +383,14 @@ class EngineConfig:
                 "EngineConfig.decode_workers must be >= 0 (0 disables "
                 f"the decode pool), got {cls.decode_workers!r}")
         positive("decode_pool_inflight", cls.decode_pool_inflight)
+        if not isinstance(cls.columnar_images, bool):
+            raise ValueError(
+                "EngineConfig.columnar_images must be a bool, got "
+                f"{cls.columnar_images!r}")
+        if not isinstance(cls.fused_preprocess, bool):
+            raise ValueError(
+                "EngineConfig.fused_preprocess must be a bool, got "
+                f"{cls.fused_preprocess!r}")
         if cls.cluster_workers < 0:
             raise ValueError(
                 "EngineConfig.cluster_workers must be >= 0 (0 disables "
@@ -769,6 +796,9 @@ class DataFrame:
         return self.toArrow().to_pandas()
 
     def collect(self) -> List[Dict[str, Any]]:
+        # sparkdl: allow(columnar-hot-path): collect's CONTRACT is
+        # per-row Python dicts (Spark Row analog); batch callers use
+        # streamPartitions/toArrow
         return self.toArrow().to_pylist()
 
     def count(self) -> int:
@@ -1001,6 +1031,9 @@ class DataFrame:
         out_type = outputType
 
         def op(batch: pa.RecordBatch) -> pa.RecordBatch:
+            # sparkdl: allow(columnar-hot-path): row-wise UDF semantics —
+            # fn receives Python values by contract; vectorized work
+            # belongs in withColumnBatch
             inputs = [batch.column(batch.schema.get_field_index(c)).to_pylist()
                       for c in inputCols]
             values = [fn(*row) for row in zip(*inputs)] if inputs else []
@@ -1078,6 +1111,8 @@ class DataFrame:
                 keep = bool(predicate())
                 mask = pa.array([keep] * batch.num_rows, type=pa.bool_())
                 return batch.filter(mask)
+            # sparkdl: allow(columnar-hot-path): row-wise predicate
+            # semantics — the user callable receives Python values
             inputs = [batch.column(batch.schema.get_field_index(c)).to_pylist()
                       for c in inputCols]
             mask = pa.array([bool(predicate(*row)) for row in zip(*inputs)],
@@ -1184,6 +1219,8 @@ class DataFrame:
         # frozen (nested list/struct/binary keys hash like distinct()'s).
         right_table = other.toArrow()
         build: Dict[Any, List[Dict[str, Any]]] = defaultdict(list)
+        # sparkdl: allow(columnar-hot-path): hash-join build side needs
+        # hashable Python keys — documented metadata-frame operation
         for r in right_table.to_pylist():
             key = tuple(_freeze_value(r[k]) for k in keys)
             if any(v is None for v in key):
@@ -1206,6 +1243,8 @@ class DataFrame:
         out_tables: List[pa.Table] = []
         for batch in left_batches:
             out_rows: List[Dict[str, Any]] = []
+            # sparkdl: allow(columnar-hot-path): hash-join probe side —
+            # same Python-key hashing as the build side above
             for r in batch.to_pylist():
                 key = tuple(_freeze_value(r[k]) for k in keys)
                 matches = ([] if any(v is None for v in key)
@@ -1238,6 +1277,8 @@ class DataFrame:
             return DataFrame.fromArrow(table, numPartitions=1)
         seen = set()
         keep = []
+        # sparkdl: allow(columnar-hot-path): distinct() hashes Python
+        # values by design (documented metadata-frame cost note above)
         for i, row in enumerate(table.to_pylist()):
             key = tuple(_freeze_value(v) for v in row.values())
             if key not in seen:
@@ -1581,6 +1622,8 @@ def column_to_numpy(arr, dtype=None) -> np.ndarray:
         values = arr.flatten().to_numpy(zero_copy_only=False)
         out = values.reshape(len(arr), k)
     elif pa.types.is_list(arr.type) or pa.types.is_large_list(arr.type):
+        # sparkdl: allow(columnar-hot-path): generic-list fallback for
+        # ragged rows; uniform vector columns take list_column_to_numpy
         rows = arr.to_pylist()
         out = np.asarray(rows)
     else:
@@ -1588,3 +1631,37 @@ def column_to_numpy(arr, dtype=None) -> np.ndarray:
     if dtype is not None:
         out = np.asarray(out, dtype=dtype)
     return out
+
+
+def list_column_to_numpy(arr, element_nulls: str = "reject"
+                         ) -> Optional[np.ndarray]:
+    """Uniform-width list column → (n_valid, K) float64 matrix, no per-row
+    Python (docs/PERF.md "Columnar data plane"): null ROWS drop via one
+    vectorized filter, the element buffer flattens through numpy once.
+    Returns None when the column is not list-typed, rows are ragged, or —
+    under ``element_nulls="reject"`` — elements are null; callers fall
+    back to their per-row path, so semantics for irregular data are
+    unchanged. ``element_nulls="nan"`` maps null elements to NaN instead
+    (the Imputer's missing-value convention)."""
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    fixed = pa.types.is_fixed_size_list(arr.type)
+    if not (fixed or pa.types.is_list(arr.type)
+            or pa.types.is_large_list(arr.type)):
+        return None
+    if arr.null_count:
+        arr = arr.drop_null()
+    n = len(arr)
+    if fixed:
+        width = arr.type.list_size
+    else:
+        offsets = arr.offsets.to_numpy()
+        widths = np.diff(offsets)
+        if widths.size and not (widths == widths[0]).all():
+            return None  # ragged vectors — per-row path validates/raises
+        width = int(widths[0]) if widths.size else 0
+    flat = arr.flatten()  # respects slice offsets and dropped rows
+    if flat.null_count and element_nulls != "nan":
+        return None
+    values = flat.to_numpy(zero_copy_only=False)  # nulls → NaN (float64)
+    return np.asarray(values, np.float64).reshape(n, width)

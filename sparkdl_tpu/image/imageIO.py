@@ -265,6 +265,105 @@ def arrowImageBatch(col) -> Optional[Tuple[np.ndarray, np.ndarray]]:
     return batch, valid_idx
 
 
+def _structColumnPerRow(arrays: Sequence[Optional[np.ndarray]],
+                        origins: Sequence[str]) -> pa.Array:
+    """Per-row image-struct column builder — the compatibility path for
+    ragged batches and ``EngineConfig.columnar_images = False``."""
+    # sparkdl: allow(columnar-hot-path): THE per-row fallback the
+    # columnar builder degrades to for ragged/odd-dtype batches; uniform
+    # batches never reach it
+    values = [imageArrayToStruct(np.asarray(a), origin=o)
+              if a is not None else None
+              for a, o in zip(arrays, origins)]
+    return pa.array(values, type=imageSchema)
+
+
+def imageArraysToStructColumn(arrays: Sequence[Optional[np.ndarray]],
+                              origins: Sequence[str]) -> pa.Array:
+    """Image-struct column from decoded HWC arrays (None = null row).
+
+    Columnar fast path (``EngineConfig.columnar_images``, docs/PERF.md
+    "Columnar data plane"): a uniform-shape/-dtype batch packs into ONE
+    contiguous values buffer wrapped as the column's binary child —
+    zero-copy when the arrays are already consecutive views of one base
+    buffer (the decode pool's single-copy adoption), one vectorized
+    ``np.stack`` otherwise — and the height/width/channels/mode children
+    are vectorized int32 arrays. No per-row dict, no per-row
+    ``tobytes``; :func:`arrowImageBatch` recovers the NHWC view
+    downstream without copying. The column is logically identical to the
+    per-row builder's output; ragged batches (mixed shapes/dtypes,
+    2-D grayscale) and ``columnar_images = False`` take the per-row
+    path.
+    """
+    from sparkdl_tpu.engine.dataframe import EngineConfig  # lazy: no cycle
+
+    n = len(arrays)
+    if n == 0 or not EngineConfig.columnar_images:
+        return _structColumnPerRow(arrays, origins)
+    valid = [i for i, a in enumerate(arrays) if a is not None]
+    if not valid:
+        return pa.array([None] * n, type=imageSchema)
+    first = arrays[valid[0]]
+    if (not isinstance(first, np.ndarray) or first.ndim != 3
+            or any(not isinstance(arrays[i], np.ndarray)
+                   or arrays[i].shape != first.shape
+                   or arrays[i].dtype != first.dtype for i in valid[1:])):
+        return _structColumnPerRow(arrays, origins)
+    try:
+        mode = imageTypeForArray(first).ocvType
+    except ValueError:  # dtype outside the OpenCV codes
+        return _structColumnPerRow(arrays, origins)
+    h, w, c = first.shape
+    row_bytes = h * w * c * first.dtype.itemsize
+    if row_bytes * len(valid) > np.iinfo(np.int32).max:
+        # pa.binary() carries int32 offsets; a partition this large is
+        # pathological anyway — let the per-row builder chunk it
+        return _structColumnPerRow(arrays, origins)
+    flat = _contiguousValues([arrays[i] for i in valid], row_bytes)
+    lengths = np.zeros(n, dtype=np.int64)
+    lengths[valid] = row_bytes  # null rows: zero-length payload slots
+    offsets = np.zeros(n + 1, dtype=np.int32)
+    np.cumsum(lengths, out=offsets[1:])
+    data_child = pa.Array.from_buffers(
+        pa.binary(), n, [None, pa.py_buffer(offsets), pa.py_buffer(flat)])
+    meta = np.zeros(n, dtype=np.int32)
+    children = [pa.array(["" if o is None else o for o in origins],
+                         type=pa.string())]
+    for fill in (h, w, c, mode):
+        col = meta.copy()
+        col[valid] = fill
+        children.append(pa.array(col))
+    children.append(data_child)
+    mask = None
+    if len(valid) < n:
+        null_mask = np.ones(n, dtype=bool)
+        null_mask[valid] = False
+        mask = pa.array(null_mask)
+    return pa.StructArray.from_arrays(
+        children, names=[f.name for f in imageSchema], mask=mask)
+
+
+def _contiguousValues(arrs: List[np.ndarray], row_bytes: int) -> np.ndarray:
+    """One flat uint8 buffer holding every array's pixels, in order.
+
+    Zero-copy when the arrays are already consecutive C-contiguous views
+    of a single 1-D uint8 base (what ``decode_pool._adopt_result`` hands
+    back): the base's spanning slice IS the values buffer. Otherwise one
+    vectorized ``np.stack`` — a single memcpy, never a per-row Python
+    hop."""
+    base = arrs[0].base
+    if (isinstance(base, np.ndarray) and base.ndim == 1
+            and base.dtype == np.uint8 and base.flags["C_CONTIGUOUS"]):
+        base_ptr = base.__array_interface__["data"][0]
+        ptr0 = arrs[0].__array_interface__["data"][0]
+        if all(a.base is base and a.flags["C_CONTIGUOUS"]
+               and a.__array_interface__["data"][0] == ptr0 + k * row_bytes
+               for k, a in enumerate(arrs)):
+            start = ptr0 - base_ptr
+            return base[start:start + row_bytes * len(arrs)]
+    return np.ascontiguousarray(np.stack(arrs)).view(np.uint8).reshape(-1)
+
+
 # ---------------------------------------------------------------------------
 # Decode / resize (native fast path, PIL fallback)
 # ---------------------------------------------------------------------------
@@ -677,8 +776,12 @@ def _decodeBlobsDefault(blobs: Sequence[Optional[bytes]]
 
 def _readImagesDecodePartition(batch) -> pa.Array:
     """Whole-partition decode op for the DEFAULT ``readImages`` decoder:
-    read every file, batch-decode (pool-aware), wrap as image structs."""
+    read every file, batch-decode (pool-aware), wrap as an image-struct
+    column — columnar (zero-copy, docs/PERF.md "Columnar data plane")
+    when the partition decodes uniform."""
     idx = batch.schema.get_field_index("filePath")
+    # sparkdl: allow(columnar-hot-path): string URI column — per-row
+    # Python strings are the product here, not pixels
     uris = batch.column(idx).to_pylist()
     with profiling.annotate("sparkdl.decode", rows=len(uris)):
         blobs: List[Optional[bytes]] = []
@@ -689,10 +792,7 @@ def _readImagesDecodePartition(batch) -> pa.Array:
             except OSError:
                 blobs.append(None)
         arrays = _decodeBlobsDefault(blobs)
-    values = [imageArrayToStruct(np.asarray(a), origin=u)
-              if a is not None else None
-              for a, u in zip(arrays, uris)]
-    return pa.array(values, type=imageSchema)
+    return imageArraysToStructColumn(arrays, uris)
 
 
 def readImagesWithCustomFn(path: str, decode_f: Callable[[bytes], Optional[np.ndarray]],

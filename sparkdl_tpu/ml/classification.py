@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from sparkdl_tpu.engine.dataframe import list_column_to_numpy
 from sparkdl_tpu.ml.base import Estimator, Model
 from sparkdl_tpu.ml.persistence import ParamsOnlyPersistence
 from sparkdl_tpu.param.base import Param, keyword_only
@@ -366,18 +367,28 @@ class LogisticRegressionModel(Model, _HasClassifierCols):
 
         def predict_batch(batch: "pa.RecordBatch") -> "pa.Array":
             col = batch.column(batch.schema.get_field_index(feat_col))
-            rows = col.to_pylist()
+            # columnar hoist: uniform vector column → one (n, K) view
+            n_rows = len(col)
+            xmat = list_column_to_numpy(col)
+            if xmat is not None:
+                valid = np.flatnonzero(col.is_valid()).tolist()
+                x = np.asarray(xmat, np.float32)
+            else:
+                # sparkdl: allow(columnar-hot-path): ragged fallback —
+                # uniform vector batches take the hoist above
+                rows = col.to_pylist()
+                valid = [i for i, r in enumerate(rows) if r is not None]
+                x = (np.asarray([rows[i] for i in valid], np.float32)
+                     if valid else None)
             out = []
             probs_by_row: Dict[int, np.ndarray] = {}
-            valid = [i for i, r in enumerate(rows) if r is not None]
             if valid:
-                x = np.asarray([rows[i] for i in valid], np.float32)
                 logits = x @ w + b
                 logits -= logits.max(axis=1, keepdims=True)
                 e = np.exp(logits)
                 probs = e / e.sum(axis=1, keepdims=True)
                 probs_by_row = dict(zip(valid, probs))
-            for i in range(len(rows)):
+            for i in range(n_rows):
                 out.append(probs_by_row[i].tolist() if i in probs_by_row
                            else None)
             return pa.array(out, type=pa.list_(pa.float32()))

@@ -190,7 +190,17 @@ class KerasImageFileEstimator(Estimator, HasInputCol, HasOutputCol,
         keep = [i for i, s in enumerate(structs) if s is not None]
         x = imageIO.imageStructsToBatchArray(
             [structs[i] for i in keep], target_size=target_size,
-            dtype=mf.input_spec.dtype)
+            dtype=None)
+        if x.dtype != np.dtype(mf.input_spec.dtype):
+            if (x.dtype == np.uint8
+                    and np.dtype(mf.input_spec.dtype) == np.dtype(np.float32)):
+                # keep uint8: Trainer.stage_batch transfers raw bytes and
+                # casts to float32 on device (exact for 0-255) — same rule
+                # as the streaming path (_partition_arrays_inner), so both
+                # staging paths feed the device identical programs.
+                pass
+            else:
+                x = x.astype(mf.input_spec.dtype)
         y = np.asarray([labels[i] for i in keep])
         return x, y
 
@@ -656,8 +666,12 @@ class _PartitionBatchStream:
             x, valid_idx = fast
             import pyarrow as pa
 
+            # sparkdl: allow(columnar-hot-path): label column — may hold
+            # strings/objects; tiny next to the pixel payload
             y = np.asarray(labels.take(pa.array(valid_idx)).to_pylist())
         else:
+            # sparkdl: allow(columnar-hot-path): compatibility fallback —
+            # ragged partitions only; uniform columns take arrowImageBatch
             structs = col.to_pylist()
             valid = [i for i, s in enumerate(structs) if s is not None]
             if not valid:
@@ -665,6 +679,8 @@ class _PartitionBatchStream:
             x = imageIO.imageStructsToBatchArray(
                 [structs[i] for i in valid], target_size=self._target_size,
                 dtype=None)
+            # sparkdl: allow(columnar-hot-path): label column — may hold
+            # strings/objects; tiny next to the pixel payload
             lab = labels.to_pylist()
             y = np.asarray([lab[i] for i in valid])
         if x.shape[0] == 0:

@@ -54,16 +54,18 @@ def _iter_pair_batches(dataset, prediction_col: str, label_col: str):
     for batch in frame.streamPartitions():
         if batch.num_rows == 0:
             continue
-        pred = batch.column(
-            batch.schema.get_field_index(prediction_col)).to_pylist()
-        lab = batch.column(
-            batch.schema.get_field_index(label_col)).to_pylist()
-        keep = [i for i in range(len(pred))
-                if pred[i] is not None and lab[i] is not None]
-        if not keep:
+        pcol = batch.column(batch.schema.get_field_index(prediction_col))
+        lcol = batch.column(batch.schema.get_field_index(label_col))
+        # columnar hoist: validity masks + one vectorized conversion per
+        # column — NULL rows drop (Spark convention), genuine NaN VALUES
+        # survive into the metric exactly as the per-row path kept them
+        keep = (np.asarray(pcol.is_valid()) & np.asarray(lcol.is_valid()))
+        if not keep.any():
             continue
-        yield (np.asarray([pred[i] for i in keep], np.float64),
-               np.asarray([lab[i] for i in keep], np.float64))
+        yield (np.asarray(pcol.to_numpy(zero_copy_only=False)[keep],
+                          np.float64),
+               np.asarray(lcol.to_numpy(zero_copy_only=False)[keep],
+                          np.float64))
 
 
 def _no_rows() -> ValueError:

@@ -27,6 +27,7 @@ from __future__ import annotations
 from collections import Counter
 from typing import List, Optional
 
+from sparkdl_tpu.engine.dataframe import list_column_to_numpy
 from sparkdl_tpu.ml.base import Estimator, Model, Transformer
 from sparkdl_tpu.ml.persistence import ParamsOnlyPersistence
 from sparkdl_tpu.param.base import Param, Params, keyword_only
@@ -95,6 +96,8 @@ class StringIndexer(Estimator, _IndexerParams, ParamsOnlyPersistence):
         counts: Counter = Counter()
         saw_null = False
         for batch in dataset.select(col).streamPartitions():
+            # sparkdl: allow(columnar-hot-path): string label column —
+            # indexing needs Python strings; not a tensor hop
             for v in batch.column(0).to_pylist():
                 if v is None:
                     saw_null = True
@@ -404,10 +407,19 @@ class StandardScaler(Estimator, _IndexerParams, ParamsOnlyPersistence):
         mean = None
         m2 = None
         for batch in dataset.select(col).streamPartitions():
-            rows = [r for r in batch.column(0).to_pylist() if r is not None]
-            if not rows:
+            # columnar hoist: uniform-width vector columns become one
+            # (n, K) float64 view without the per-row Python hop
+            x = list_column_to_numpy(batch.column(0))
+            if x is None:
+                # sparkdl: allow(columnar-hot-path): ragged/null-element
+                # fallback — uniform vector batches take the hoist above
+                rows = [r for r in batch.column(0).to_pylist()
+                        if r is not None]
+                if not rows:
+                    continue
+                x = np.asarray(rows, np.float64)
+            if not len(x):
                 continue
-            x = np.asarray(rows, np.float64)
             nb = len(x)
             batch_mean = x.mean(axis=0)
             batch_m2 = ((x - batch_mean) ** 2).sum(axis=0)
@@ -523,10 +535,19 @@ class MinMaxScaler(Estimator, _IndexerParams, ParamsOnlyPersistence):
         col = self.getInputCol()
         lo = hi = None
         for batch in dataset.select(col).streamPartitions():
-            rows = [r for r in batch.column(0).to_pylist() if r is not None]
-            if not rows:
+            # columnar hoist; null ELEMENTS surface as NaN and fail the
+            # finite check below with the same error as the row path
+            x = list_column_to_numpy(batch.column(0), element_nulls="nan")
+            if x is None:
+                # sparkdl: allow(columnar-hot-path): ragged fallback —
+                # uniform vector batches take the hoist above
+                rows = [r for r in batch.column(0).to_pylist()
+                        if r is not None]
+                if not rows:
+                    continue
+                x = np.asarray(rows, np.float64)
+            if not len(x):
                 continue
-            x = np.asarray(rows, np.float64)
             if not np.isfinite(x).all():
                 # NaN would poison min/max and the transform would then
                 # silently midpoint the whole dimension — demand finite
@@ -640,12 +661,21 @@ class Imputer(Estimator, _IndexerParams, ParamsOnlyPersistence):
             # the scalers)
             total = count = None
             for batch in dataset.select(col).streamPartitions():
-                rows = [r for r in batch.column(0).to_pylist()
-                        if r is not None]
-                if not rows:
+                # columnar hoist: null ELEMENTS map to NaN — exactly the
+                # row path's None→NaN convention below
+                x = list_column_to_numpy(batch.column(0),
+                                         element_nulls="nan")
+                if x is None:
+                    # sparkdl: allow(columnar-hot-path): ragged fallback —
+                    # uniform vector batches take the hoist above
+                    rows = [r for r in batch.column(0).to_pylist()
+                            if r is not None]
+                    if not rows:
+                        continue
+                    x = np.asarray([[np.nan if e is None else e for e in r]
+                                    for r in rows], np.float64)
+                if not len(x):
                     continue
-                x = np.asarray([[np.nan if e is None else e for e in r]
-                                for r in rows], np.float64)
                 observed = ~np.isnan(x)
                 bsum = np.where(observed, x, 0.0).sum(axis=0)
                 bcnt = observed.sum(axis=0)

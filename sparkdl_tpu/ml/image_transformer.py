@@ -43,7 +43,7 @@ logger = logging.getLogger(__name__)
 
 from sparkdl_tpu.core import executor as device_executor
 from sparkdl_tpu.core import profiling
-from sparkdl_tpu.engine.dataframe import fixed_size_list_array
+from sparkdl_tpu.engine.dataframe import EngineConfig, fixed_size_list_array
 from sparkdl_tpu.image import imageIO
 from sparkdl_tpu.ml.base import Transformer
 from sparkdl_tpu.ml.persistence import ModelFunctionPersistence
@@ -174,10 +174,15 @@ class TPUImageTransformer(Transformer, HasInputCol, HasOutputCol,
                         priority=priority)
                 if mode == "vector":
                     return _vectors_with_nulls(out, valid, batch.num_rows)
+                # sparkdl: allow(columnar-hot-path): origin strings — the
+                # image-output wrapper needs Python strings per row
                 origins = col.field("origin").take(
                     pa.array(valid_np)).to_pylist()
                 return _images_with_nulls(out, valid, batch.num_rows, origins)
 
+            # sparkdl: allow(columnar-hot-path): compatibility fallback —
+            # only ragged/non-uniform partitions reach here; uniform
+            # columns take the zero-copy arrowImageBatch branch above
             structs = col.to_pylist()
             present = [i for i, s in enumerate(structs) if s is not None]
             # dtype=None: uint8 images stage as uint8 (4x fewer DMA bytes);
@@ -234,9 +239,19 @@ def _resize_uniform_batch(stacked: np.ndarray, target_size, run):
     Both are pixel-center bilinear without antialiasing; they differ only
     by uint8 rounding. Returns the (possibly resized) batch and the
     (possibly resize-composed) ModelFunction.
+
+    Under ``EngineConfig.fused_preprocess`` (the default; docs/PERF.md
+    "Columnar data plane") the host never resizes at all: the raw uint8
+    batch ships at source size and resize fuses into the compiled
+    program via ``ModelFunction.resized`` — cast/resize/normalize/
+    forward become one XLA program, and the host's only per-image work
+    is the Arrow wrap. The legacy byte-minimizing host-downscale policy
+    below is kept for ``fused_preprocess=False``.
     """
     if target_size is None or tuple(stacked.shape[1:3]) == tuple(target_size):
         return stacked, run
+    if EngineConfig.fused_preprocess:
+        return stacked, run.resized(stacked.shape[1:3], tuple(target_size))
     src_px = stacked.shape[1] * stacked.shape[2]
     tgt_px = target_size[0] * target_size[1]
     # Byte-minimizing policy, measured (r3): sending the larger source and
@@ -282,5 +297,8 @@ def _images_with_nulls(out: np.ndarray, valid, num_rows: int,
         arr = out[j]
         if arr.dtype not in (np.uint8, np.float32):
             arr = arr.astype(np.float32)
+        # sparkdl: allow(columnar-hot-path): output-mode="image" wrapper —
+        # null interleaving forces per-row structs; model OUTPUT columns,
+        # not the ingest spine
         values[i] = imageIO.imageArrayToStruct(arr, origin=origins[j])
     return pa.array(values, type=imageIO.imageSchema)

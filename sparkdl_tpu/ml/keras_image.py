@@ -5,6 +5,14 @@ mixes in ``CanLoadImage`` (URI → decode → user preprocessor → image
 struct), converts the Keras model and runs it through the image
 transformer. Here the Keras model is ingested once by the generic layer-DAG
 walker (models.keras_ingest) into a jitted XLA program.
+
+Data plane: ``loadImagesInternal`` builds its decoded column through the
+zero-copy columnar builder (``imageIO.imageArraysToStructColumn``, gated
+by ``EngineConfig.columnar_images``), and the inner TPUImageTransformer
+ships raw uint8 with resize/normalize fused into the compiled program
+under ``EngineConfig.fused_preprocess`` — see docs/PERF.md "Columnar
+data plane". No code here changes for that: this transformer rides the
+shared ingest spine.
 """
 
 from __future__ import annotations

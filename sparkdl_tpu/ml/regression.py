@@ -38,6 +38,7 @@ from typing import Optional
 
 import numpy as np
 
+from sparkdl_tpu.engine.dataframe import list_column_to_numpy
 from sparkdl_tpu.ml.base import Estimator, Model
 from sparkdl_tpu.ml.linear_utils import validate_weights, weighted_feature_std
 from sparkdl_tpu.ml.persistence import ParamsOnlyPersistence
@@ -223,13 +224,21 @@ class LinearRegressionModel(Model, _HasRegressionCols):
 
         def predict_batch(batch: "pa.RecordBatch") -> "pa.Array":
             col = batch.column(batch.schema.get_field_index(feat_col))
-            rows = col.to_pylist()
-            valid = [i for i, r in enumerate(rows) if r is not None]
-            out = [None] * len(rows)
+            # columnar hoist: uniform vector column → one (n, K) view
+            n_rows = len(col)
+            x = list_column_to_numpy(col)
+            if x is not None:
+                valid = np.flatnonzero(col.is_valid()).tolist()
+            else:
+                # sparkdl: allow(columnar-hot-path): ragged fallback —
+                # uniform vector batches take the hoist above
+                rows = col.to_pylist()
+                valid = [i for i, r in enumerate(rows) if r is not None]
+                x = np.asarray([rows[i] for i in valid], np.float64)
+            out = [None] * n_rows
             if valid:
                 # one matmul per Arrow batch, not a dot per row
-                preds = np.asarray([rows[i] for i in valid],
-                                   np.float64) @ beta + b
+                preds = np.asarray(x, np.float64) @ beta + b
                 for j, i in enumerate(valid):
                     out[i] = float(preds[j])
             return pa.array(out, type=pa.float64())

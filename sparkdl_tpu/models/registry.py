@@ -65,6 +65,18 @@ def preprocess_torch_mode(x: jnp.ndarray) -> jnp.ndarray:
     return (x - mean) / std
 
 
+# Normalize-mode catalog: every per-model-family preprocess the registry
+# fuses into the device program (ModelFunction.with_preprocess). The
+# columnar-plane equivalence tests sweep this map so a newly added mode
+# is covered automatically (tests/image/test_columnar_plane.py).
+PREPROCESS_MODES: Dict[str, Callable] = {
+    "tf": preprocess_tf_mode,
+    "caffe": preprocess_caffe_mode,
+    "torch": preprocess_torch_mode,
+    "identity": preprocess_identity,
+}
+
+
 @dataclass(frozen=True)
 class ModelSpec:
     name: str

@@ -248,11 +248,11 @@ class CanLoadImage(Params):
                     idx = batch.schema.get_field_index(inputCol)
                     uris = batch.column(idx).to_pylist()
                     arrays = imageIO.decodeImageFilesBatch(uris, target_size)
-                    values = [
-                        imageIO.imageArrayToStruct(a, origin=u or "")
-                        if a is not None else None
-                        for a, u in zip(arrays, uris)]
-                    return pa.array(values, type=imageIO.imageSchema)
+                    # columnar zero-copy struct column when the decoded
+                    # batch is uniform (docs/PERF.md "Columnar data
+                    # plane"); per-row fallback otherwise
+                    return imageIO.imageArraysToStructColumn(
+                        arrays, [u or "" for u in uris])
 
             return dataframe.withColumnBatch(
                 outputCol, load_partition, outputType=imageIO.imageSchema)

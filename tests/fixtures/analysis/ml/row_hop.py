@@ -1,0 +1,20 @@
+"""Seeded violation: per-row hops over image/tensor columns on the
+data plane (columnar-hot-path; the `ml/` path segment puts this in
+scope) — a `.to_pylist()` materialization and a per-row
+`imageArrayToStruct` loop."""
+
+import pyarrow as pa
+
+from sparkdl_tpu.image.imageIO import imageArrayToStruct, imageSchema
+
+
+def stage_partition(batch):
+    col = batch.column(0)
+    structs = col.to_pylist()
+    return [s for s in structs if s is not None]
+
+
+def rebuild_column(arrays, origins):
+    values = [imageArrayToStruct(a, origin=o)
+              for a, o in zip(arrays, origins)]
+    return pa.array(values, type=imageSchema)

@@ -1,0 +1,300 @@
+"""Zero-copy columnar image plane (ISSUE 18; docs/PERF.md "Columnar
+data plane").
+
+Pins the tentpole contract: the columnar struct-column builder is
+logically identical to the per-row path (so `columnar_images` on/off and
+`decode_workers` on/off are bit-identical end to end), decode-pool
+adoption hands the builder consecutive views of ONE flat buffer that
+wrap into Arrow zero-copy, corrupt blobs degrade identically on both
+paths, fused device preprocess matches host-f32 staging per registry
+normalize mode (fp32 exact, bf16 within the 0.05 contract), and the
+host ships raw uint8 bytes only — no float32 staging, no per-row struct
+construction on the ingest spine.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from sparkdl_tpu.core import decode_pool
+from sparkdl_tpu.core import executor as device_executor
+from sparkdl_tpu.core.model_function import ModelFunction, TensorSpec
+from sparkdl_tpu.engine.dataframe import EngineConfig
+from sparkdl_tpu.image import imageIO
+from sparkdl_tpu.ml.image_transformer import (
+    TPUImageTransformer,
+    _resize_uniform_batch,
+)
+from sparkdl_tpu.models.registry import PREPROCESS_MODES
+
+
+@pytest.fixture(autouse=True)
+def _restore_engine_config():
+    saved = EngineConfig.snapshot()
+    yield
+    EngineConfig.restore(saved)
+    decode_pool.shutdown()
+
+
+@pytest.fixture
+def uniform_image_dir(tmp_path, rng):
+    """8 uniform 10x12 JPEGs — every partition decodes uniform, so the
+    columnar builder engages (ragged dirs fall back per row)."""
+    from PIL import Image
+
+    d = tmp_path / "uniform"
+    d.mkdir()
+    for i in range(8):
+        arr = rng.integers(0, 255, size=(10, 12, 3), dtype=np.uint8)
+        Image.fromarray(arr).save(d / f"img_{i}.png")
+    return d
+
+
+# ---------------------------------------------------------------------------
+# builder: logical equality + zero-copy wrap
+# ---------------------------------------------------------------------------
+
+
+def test_builder_matches_per_row_path(rng):
+    arrays = [rng.integers(0, 255, (7, 5, 3), dtype=np.uint8)
+              for _ in range(5)]
+    arrays[2] = None  # a degraded row interleaves as null on both paths
+    origins = [f"file:/img/{i}.png" for i in range(5)]
+
+    col = imageIO.imageArraysToStructColumn(arrays, origins)
+    EngineConfig.columnar_images = False
+    ref = imageIO.imageArraysToStructColumn(arrays, origins)
+
+    assert col.type == ref.type == imageIO.imageSchema
+    assert col.to_pylist() == ref.to_pylist()
+    # both feed the SAME zero-copy consumer downstream
+    fast = imageIO.arrowImageBatch(col)
+    fast_ref = imageIO.arrowImageBatch(ref)
+    assert fast is not None and fast_ref is not None
+    np.testing.assert_array_equal(fast[0], fast_ref[0])
+    np.testing.assert_array_equal(fast[1], fast_ref[1])
+
+
+def test_builder_wraps_contiguous_views_zero_copy(rng):
+    """Consecutive views over one flat uint8 base (exactly what
+    decode-pool adoption produces) must wrap WITHOUT copying: the Arrow
+    data child's buffer address is the base's address."""
+    h, w, c = 6, 4, 3
+    row = h * w * c
+    flat = rng.integers(0, 255, row * 3, dtype=np.uint8)
+    views = [flat[i * row:(i + 1) * row].reshape(h, w, c) for i in range(3)]
+
+    col = imageIO.imageArraysToStructColumn(views, ["a", "b", "c"])
+    data_buf = col.field("data").buffers()[2]
+    assert data_buf.address == flat.__array_interface__["data"][0]
+    # and the round trip reads the same pixels
+    batch, valid = imageIO.arrowImageBatch(col)
+    np.testing.assert_array_equal(batch,
+                                  flat.reshape(3, h, w, c))
+
+
+def test_builder_ragged_and_odd_input_falls_back(rng):
+    ragged = [rng.integers(0, 255, (4, 4, 3), dtype=np.uint8),
+              rng.integers(0, 255, (5, 4, 3), dtype=np.uint8)]
+    col = imageIO.imageArraysToStructColumn(ragged, ["a", "b"])
+    EngineConfig.columnar_images = False
+    ref = imageIO.imageArraysToStructColumn(ragged, ["a", "b"])
+    assert col.to_pylist() == ref.to_pylist()
+
+    all_null = imageIO.imageArraysToStructColumn([None, None], ["a", "b"])
+    assert all_null.to_pylist() == [None, None]
+    assert all_null.type == imageIO.imageSchema
+
+
+def test_decode_pool_adoption_feeds_builder_zero_copy(rng):
+    """Pool adoption = ONE memcpy out of shm; the resulting views share
+    one base the builder detects, so pool→Arrow adds no further copy."""
+    arrays = [rng.integers(0, 255, (5, 5, 3), dtype=np.uint8)
+              for _ in range(3)]
+    meta = decode_pool._pack_result(arrays, [0.0] * 3, 4242)
+    adopted = decode_pool._adopt_result(meta)
+    base = adopted[0].base
+    assert all(a.base is base for a in adopted)
+    for got, want in zip(adopted, arrays):
+        np.testing.assert_array_equal(got, want)
+
+    col = imageIO.imageArraysToStructColumn(adopted, ["x", "y", "z"])
+    assert (col.field("data").buffers()[2].address
+            == base.__array_interface__["data"][0])
+
+
+# ---------------------------------------------------------------------------
+# end-to-end bit-identity: pool on/off x columnar on/off
+# ---------------------------------------------------------------------------
+
+
+def _collect_images(image_dir):
+    df = imageIO.readImages(str(image_dir))
+    return df.collect()
+
+
+@pytest.mark.parametrize("corrupt", [False, True])
+def test_read_images_bit_identical_across_matrix(uniform_image_dir,
+                                                 corrupt):
+    """readImages output is bit-identical across decode pool on/off x
+    columnar on/off; with a corrupt blob, every combo degrades the SAME
+    row to null and records the SAME decode_degraded count."""
+    from sparkdl_tpu.core.health import HealthMonitor
+
+    if corrupt:
+        (uniform_image_dir / "aa_corrupt.png").write_bytes(b"not a png")
+
+    results = {}
+    for workers in (0, 2):
+        for columnar in (True, False):
+            EngineConfig.decode_workers = workers
+            EngineConfig.columnar_images = columnar
+            with HealthMonitor() as mon:
+                rows = _collect_images(uniform_image_dir)
+            decode_pool.shutdown()
+            results[(workers, columnar)] = (
+                rows, mon.count("decode_degraded"))
+
+    baseline_rows, baseline_degraded = results[(0, False)]
+    assert len(baseline_rows) == (9 if corrupt else 8)
+    assert baseline_degraded == (1 if corrupt else 0)
+    if corrupt:
+        by_path = {r["filePath"]: r["image"] for r in baseline_rows}
+        nulls = [p for p, img in by_path.items() if img is None]
+        assert len(nulls) == 1 and nulls[0].endswith("aa_corrupt.png")
+    for key, (rows, degraded) in results.items():
+        assert rows == baseline_rows, f"combo {key} diverged"
+        assert degraded == baseline_degraded, f"combo {key} health diverged"
+
+
+# ---------------------------------------------------------------------------
+# fused preprocess: per-normalize-mode equivalence
+# ---------------------------------------------------------------------------
+
+
+def _mode_model(mode_fn, size):
+    """Forward = per-image channel means after the mode's normalize —
+    sensitive to scale, sign, and channel order (catches a BGR flip)."""
+    import jax.numpy as jnp
+
+    mf = ModelFunction(
+        lambda vs, x: jnp.mean(x, axis=(1, 2)) + vs,
+        jnp.zeros((3,), jnp.float32),
+        TensorSpec((None,) + size + (3,), "float32"),
+        name=f"mode_{id(mode_fn)}")
+    return mf.with_preprocess(mode_fn)
+
+
+@pytest.mark.parametrize("mode", sorted(PREPROCESS_MODES))
+def test_fused_preprocess_matches_host_f32_staging(rng, mode):
+    """fp32: shipping raw uint8 at SOURCE size through the fused
+    resize+normalize program is EXACT vs staging float32 host-side into
+    the same program (uint8→f32 cast is exact for 0-255)."""
+    EngineConfig.fused_preprocess = True
+    run = _mode_model(PREPROCESS_MODES[mode], (6, 6))
+    stacked = rng.integers(0, 255, (4, 9, 8, 3), dtype=np.uint8)
+
+    shipped, fused = _resize_uniform_batch(stacked, (6, 6), run)
+    assert shipped.dtype == np.uint8 and shipped is stacked  # no host work
+    y_fused = np.asarray(device_executor.execute(fused, shipped,
+                                                 batch_size=4))
+    y_ref = np.asarray(device_executor.execute(
+        fused, stacked.astype(np.float32), batch_size=4))
+    np.testing.assert_array_equal(y_fused, y_ref)
+
+
+@pytest.mark.parametrize("mode", sorted(PREPROCESS_MODES))
+def test_fused_preprocess_bf16_within_contract(rng, mode):
+    """bf16: the fused path obeys the PR 12 precision contract — within
+    0.05 of the fp32 result, scaled to the mode's output magnitude."""
+    EngineConfig.fused_preprocess = True
+    run = _mode_model(PREPROCESS_MODES[mode], (6, 6))
+    stacked = rng.integers(0, 255, (4, 9, 8, 3), dtype=np.uint8)
+
+    shipped, fused = _resize_uniform_batch(stacked, (6, 6), run)
+    y32 = np.asarray(device_executor.execute(fused, shipped, batch_size=4))
+    EngineConfig.inference_precision = "bfloat16"
+    y16 = np.asarray(device_executor.execute(fused, shipped, batch_size=4),
+                     dtype=np.float32)
+    scale = float(np.max(np.abs(y32))) + 1.0
+    np.testing.assert_allclose(y16, y32, atol=0.05 * scale)
+
+
+def test_fused_off_restores_host_resize_policy(rng):
+    """fused_preprocess=False keeps the legacy r3 byte-minimizing
+    policy: downscales resize on host, the model is left alone."""
+    EngineConfig.fused_preprocess = False
+    run = _mode_model(PREPROCESS_MODES["identity"], (6, 6))
+    stacked = rng.integers(0, 255, (4, 9, 8, 3), dtype=np.uint8)
+    shipped, run_out = _resize_uniform_batch(stacked, (6, 6), run)
+    assert shipped.shape == (4, 6, 6, 3)  # host resized
+    assert run_out is run  # no device resize composed
+
+
+# ---------------------------------------------------------------------------
+# acceptance: host ships uint8 only, zero per-row struct construction
+# ---------------------------------------------------------------------------
+
+
+def test_host_ships_uint8_no_per_row_structs(uniform_image_dir,
+                                             monkeypatch):
+    """The ingest spine's acceptance assert: on the columnar path the
+    executor receives RAW UINT8 at source size (no float32 staging, no
+    host resize) and imageArrayToStruct never runs during ingest."""
+    struct_calls = []
+    real_struct = imageIO.imageArrayToStruct
+    monkeypatch.setattr(
+        imageIO, "imageArrayToStruct",
+        lambda *a, **k: struct_calls.append(1) or real_struct(*a, **k))
+
+    staged = []
+    real_execute = device_executor.execute
+
+    def capture(model, array, **kw):
+        staged.append(np.asarray(array))
+        return real_execute(model, array, **kw)
+
+    monkeypatch.setattr(device_executor, "execute", capture)
+    import sparkdl_tpu.ml.image_transformer as it_mod
+    monkeypatch.setattr(it_mod.device_executor, "execute", capture)
+
+    import jax.numpy as jnp
+    mf = ModelFunction(
+        lambda vs, x: x.reshape((x.shape[0], -1)) @ vs,
+        jnp.ones((6 * 6 * 3, 2), jnp.float32) * 0.01,
+        TensorSpec((None, 6, 6, 3), "float32"), name="u8_probe")
+
+    df = imageIO.readImages(str(uniform_image_dir))
+    t = TPUImageTransformer(inputCol="image", outputCol="f",
+                            modelFunction=mf, batchSize=8)
+    rows = t.transform(df).select("f").collect()
+
+    assert len(rows) == 8 and all(r["f"] is not None for r in rows)
+    assert staged, "executor.execute never saw the ingest batches"
+    for arr in staged:
+        assert arr.dtype == np.uint8, "host staged non-uint8 bytes"
+        assert arr.shape[1:3] == (10, 12), "host resized before shipping"
+    assert not struct_calls, (
+        "per-row imageArrayToStruct ran on the columnar ingest spine")
+
+
+def test_staged_bytes_counter_counts_uint8_payload(uniform_image_dir):
+    """M_STAGED_BYTES totals exactly the raw uint8 payload bytes — the
+    trajectory observable for float32-staging regressions."""
+    from sparkdl_tpu.core import telemetry
+
+    import jax.numpy as jnp
+    mf = ModelFunction(
+        lambda vs, x: x.reshape((x.shape[0], -1)) @ vs,
+        jnp.ones((6 * 6 * 3, 2), jnp.float32) * 0.01,
+        TensorSpec((None, 6, 6, 3), "float32"), name="u8_bytes")
+
+    df = imageIO.readImages(str(uniform_image_dir))
+    t = TPUImageTransformer(inputCol="image", outputCol="f",
+                            modelFunction=mf, batchSize=8)
+    with telemetry.Telemetry("columnar-bytes") as tel:
+        rows = t.transform(df).select("f").collect()
+    assert all(r["f"] is not None for r in rows)
+    snap = tel.metrics.snapshot()
+    staged = snap["counters"][telemetry.M_STAGED_BYTES]
+    assert staged == 8 * 10 * 12 * 3  # raw uint8 pixels, nothing more

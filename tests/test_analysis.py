@@ -78,6 +78,7 @@ EXPECTED_FIXTURE_RULES = {
     "broad_retry.py": {"broad-retry"},
     "ml/choke_point.py": {"executor-choke-point"},
     "ml/precision_donation.py": {"executor-choke-point"},
+    "ml/row_hop.py": {"columnar-hot-path"},
     "serving/hot_path.py": {"executor-choke-point"},
     "serving/untagged_execute.py": {"tenant-tag"},
     "serving/untagged_cluster_dispatch.py": {"tenant-tag"},

@@ -79,7 +79,7 @@ _VARIABLES = _MODULE.init(jax.random.PRNGKey(0),
                           np.zeros((1, _FEATURES), np.float32))
 
 
-def _run_pipeline(image_dir, ckpt_dir):
+def _run_pipeline(image_dir, ckpt_dir, feature_model=None):
     """files → decode (1 task) → transform (3 partitions) → fit (TPURunner
     gang, per-step checkpoints). Returns (features, labels, final_state,
     executed-step trace)."""
@@ -91,8 +91,8 @@ def _run_pipeline(image_dir, ckpt_dir):
         ["filePath"], pa.int64())
     df = df.repartition(3)  # materializes the decode; transform fans out
     t = TPUImageTransformer(inputCol="image", outputCol="features",
-                            modelFunction=_feature_model(), batchSize=8,
-                            outputMode="vector")
+                            modelFunction=feature_model or _feature_model(),
+                            batchSize=8, outputMode="vector")
     rows = t.transform(df).select("features", "label").collect()
     assert all(r["features"] is not None for r in rows)
     x = np.asarray([r["features"] for r in rows], dtype=np.float32)
@@ -335,6 +335,67 @@ def test_chaos_pipeline_with_decode_pool_bit_identical(image_dir, tmp_path):
                                    rtol=1e-6, atol=1e-7)
     # the same counter set the pool-off chaos run pins — the decode
     # fault fires in the SUBMITTING process, so pool on/off agree
+    assert mon.count(health.DECODE_DEGRADED) == 1
+    assert mon.count(health.TASK_RETRIED) == 1
+    assert mon.count(health.OOM_RECHUNK) == 1
+    assert mon.count(health.CHUNK_RETRY) == 1
+    assert mon.count(health.GANG_RESTART) == 1
+    assert mon.count(health.FIT_RESUMED) == 1
+    assert mon.count(health.FIT_COMPLETED) == 1
+    assert mon.count(health.TASK_QUARANTINED) == 0
+    assert mon.count(health.DECODE_POOL_RESPAWN) == 0
+
+
+def test_chaos_pipeline_columnar_fused_bit_identical(image_dir, tmp_path):
+    """ISSUE 18 satellite: the FULL 5-fault chaos run with the zero-copy
+    columnar plane, device-fused preprocess (a 6x6 model makes the fused
+    resize REAL work, not a size-match no-op), AND the decode pool all
+    armed — bit-identical to the fault-free run under the same data
+    plane, with the exact per-fault health counter set."""
+    from sparkdl_tpu.core import decode_pool
+
+    import jax.numpy as jnp
+
+    def small_model() -> ModelFunction:
+        rng = np.random.default_rng(0)
+        w = jnp.asarray(rng.normal(size=(6 * 6 * 3, _FEATURES))
+                        .astype(np.float32) * 0.01)
+        return ModelFunction(
+            lambda vs, x: jnp.tanh(x.reshape((x.shape[0], -1)) @ vs),
+            w, TensorSpec((None, 6, 6, 3), "float32"), name="chaos_feat6")
+
+    EngineConfig.columnar_images = True
+    EngineConfig.fused_preprocess = True
+    x0, y0, final0, steps0 = _run_pipeline(image_dir, tmp_path / "plain",
+                                           feature_model=small_model())
+
+    EngineConfig.decode_workers = 2
+    inj = FaultInjector.seeded(
+        0,
+        decode_error=1,
+        engine_task=Fault(times=1, when=lambda c: (
+            c.get("phase") == "finish" and c["attempt"] == 0)),
+        device_oom=Fault(times=1, when=lambda c: c["rows"] >= 8),
+        transfer_stall=1,
+        preemption=Fault(when=lambda c: c["step"] == 3),
+    )
+    try:
+        with inj, HealthMonitor("chaos-columnar") as mon:
+            x1, y1, final1, steps1 = _run_pipeline(
+                image_dir, tmp_path / "chaos", feature_model=small_model())
+    finally:
+        decode_pool.shutdown()
+
+    assert inj.fired == {"decode_error": 1, "engine_task": 1,
+                         "device_oom": 1, "transfer_stall": 1,
+                         "preemption": 1}
+    np.testing.assert_array_equal(x1, x0)
+    np.testing.assert_array_equal(y1, y0)
+    assert steps1 == steps0 == [1, 2, 3, 4, 5, 6]
+    for a, b in zip(jax.tree.leaves(final0.params),
+                    jax.tree.leaves(final1.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
     assert mon.count(health.DECODE_DEGRADED) == 1
     assert mon.count(health.TASK_RETRIED) == 1
     assert mon.count(health.OOM_RECHUNK) == 1
