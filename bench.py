@@ -1039,6 +1039,80 @@ def bench_tracing_overhead(name="EfficientNetB0", n_images=256,
     }
 
 
+def bench_federation_overhead(name="EfficientNetB0", n_images=256,
+                              workers=2, cadence_s=0.25):
+    """ISSUE 19 satellite: the metrics federation plane's cost on the
+    cluster featurize path — the same e2e files→readImages→featurize
+    pipeline across 2 workers with federation armed (workers ship
+    windowed delta frames on the cadence; the coordinator folds them
+    and runs the federated SLO watchdog on every frame) vs off
+    (``cluster_federation_s`` unset: no frames, no fold, the
+    pre-federation pipe protocol), in ONE record. The acceptance budget
+    is < 3% overhead: shipping the whole cluster's live metrics must be
+    cheap enough to leave on wherever the cluster plane runs.
+
+    Both legs run inside a telemetry scope (the tracing bench already
+    prices the scope itself) and the armed leg re-spawns the workers —
+    the cadence rides the worker boot config, so a router spawned
+    before the knob flip would measure a half-armed plane."""
+    import jax.numpy as jnp
+
+    from sparkdl_tpu.cluster import router as cluster_router
+    from sparkdl_tpu.core import telemetry
+    from sparkdl_tpu.engine.dataframe import EngineConfig
+    from sparkdl_tpu.image.imageIO import readImages
+    from sparkdl_tpu.ml import DeepImageFeaturizer
+
+    rng = np.random.default_rng(0)
+    saved = EngineConfig.snapshot()
+    results = {}
+    fed_stats = {}
+    try:
+        with tempfile.TemporaryDirectory() as d:
+            _write_jpegs(d, n_images, rng)
+            t = DeepImageFeaturizer(inputCol="image", outputCol="features",
+                                    modelName=name,
+                                    batchSize=HEADLINE_BATCH,
+                                    dtype=jnp.bfloat16, weights="random")
+
+            def run():
+                df = readImages(d, numPartition=4)
+                out = t.transform(df).select("features").collect()
+                assert len(out) == n_images
+
+            EngineConfig.cluster_workers = workers
+            with telemetry.Telemetry("bench_federation_off"):
+                run()  # warmup: spawn workers + compile everywhere
+                best, spread = _best_of(run)
+                results["off"] = (n_images / best, spread)
+                cluster_router.shutdown()
+            EngineConfig.cluster_federation_s = cadence_s
+            with telemetry.Telemetry("bench_federation_armed",
+                                     exemplar_k=4):
+                run()  # warmup: respawn with the cadence in the boot blob
+                best, spread = _best_of(run)
+                results["armed"] = (n_images / best, spread)
+                cluster_router.shutdown()  # merge reports in-scope
+                rep = cluster_router.last_cluster_report() or {}
+                fed = rep.get("federation") or {}
+                fed_stats = {
+                    "frames_ingested": fed.get("frames_ingested"),
+                    "workers_known": fed.get("workers_known"),
+                }
+    finally:
+        EngineConfig.restore(saved)
+        cluster_router.shutdown()
+    ips_on, sp_on = results["armed"]
+    ips_off, sp_off = results["off"]
+    return {
+        "ips_armed": ips_on, "sp_armed": sp_on,
+        "ips_off": ips_off, "sp_off": sp_off,
+        "workers": workers, "cadence_s": cadence_s,
+        "overhead_frac": 1 - ips_on / max(ips_off, 1e-9),
+        **fed_stats,
+    }
+
+
 def bench_autoscale(n_flood=10, n_paid=2, sleep_s=0.25):
     """ISSUE 16: elastic capacity, two measurements in one record.
 
@@ -1641,6 +1715,20 @@ def main():
                  overhead_frac=round(tr["overhead_frac"], 4),
                  remote_adopted=tr.get("remote_adopted"),
                  workers_shipped=tr.get("workers_shipped"))
+            # metrics federation (ISSUE 19): workers shipping windowed
+            # delta frames + the coordinator's fold and federated SLO
+            # watchdog on the same cluster featurize, armed vs off —
+            # the acceptance budget is < 3% overhead
+            fd = bench_federation_overhead()
+            emit("federation-armed cluster featurize images/sec "
+                 "(EfficientNetB0, 2 workers, 0.25s frame cadence)",
+                 fd["ips_armed"], "images/sec",
+                 spread=round(fd["sp_armed"], 4),
+                 federation_off=round(fd["ips_off"], 2),
+                 federation_off_spread=round(fd["sp_off"], 4),
+                 overhead_frac=round(fd["overhead_frac"], 4),
+                 frames_ingested=fd.get("frames_ingested"),
+                 workers_known=fd.get("workers_known"))
             # elastic capacity (ISSUE 16): autoscale decision->join
             # latency + graceful-drain duration from the event ledger,
             # and the weighted light tenant's queue-wait p99 before vs

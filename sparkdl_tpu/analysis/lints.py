@@ -468,24 +468,90 @@ def bad_slo_rule_metrics(tree: ast.AST) -> List[Tuple[int, str]]:
     return out
 
 
+#: Sections of a windowed snapshot / federation delta frame that are
+#: keyed by metric name — a lookup into one with a typo'd name silently
+#: returns None forever, exactly the failure mode slo-metrics exists to
+#: catch (the federated fold made these lookups a public idiom:
+#: autoscaler, exporter, and watchdog all read them).
+_FRAME_SECTIONS = frozenset({"histograms", "counters", "gauges"})
+
+
+def _declared_metric(name: str) -> bool:
+    if name in _telemetry.CANONICAL_METRIC_NAMES:
+        return True
+    prefix = _telemetry.HEALTH_METRIC_PREFIX
+    return (name.startswith(prefix)
+            and name[len(prefix):] in _HEALTH_EVENT_VALUES)
+
+
+def bad_frame_metric_keys(tree: ast.AST) -> List[Tuple[int, str]]:
+    """(lineno, reason) for metric-name lookups into a windowed
+    snapshot or federation delta-frame section —
+    ``X["histograms"].get(<name>)`` and
+    ``view.attribution(<metric>, ...)`` — whose name does not
+    statically resolve to a declared metric."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not isinstance(f, ast.Attribute):
+            continue
+        key_arg = None
+        what = None
+        if (f.attr == "get" and node.args
+                and isinstance(f.value, ast.Subscript)
+                and isinstance(f.value.slice, ast.Constant)
+                and f.value.slice.value in _FRAME_SECTIONS):
+            key_arg = node.args[0]
+            what = f"[{f.value.slice.value!r}].get() metric key"
+        elif f.attr == "attribution":
+            key_arg = node.args[0] if node.args else None
+            for kw in node.keywords:
+                if kw.arg == "metric":
+                    key_arg = kw.value
+            what = "attribution() metric"
+        if key_arg is None:
+            continue
+        name = _resolve_string_expr(key_arg)
+        if name is _UNRESOLVED:
+            out.append((node.lineno,
+                        f"{what} references an undeclared module "
+                        "constant"))
+        elif name is not None and not _declared_metric(name):
+            out.append((node.lineno,
+                        f"{what}: undeclared metric {name!r}"))
+    return out
+
+
 @register
 class SLOMetricsRule(Rule):
     id = "slo-metrics"
-    title = "SLORule metrics must statically resolve to declared names"
+    title = "SLO rule metrics and frame keys must resolve to declared names"
     rationale = (
         "A typo'd metric watches nothing forever. SLORule's runtime "
         "validation catches dynamic cases; this rule catches literals "
         "and module-constant concatenations before any scope ever "
         "runs — including a typo'd MODULE CONSTANT, which would "
-        "otherwise only surface at import time.")
+        "otherwise only surface at import time. The same discipline "
+        "covers reads: a metric-name lookup into a windowed snapshot "
+        "or federation delta frame (X['histograms'].get(name), "
+        "view.attribution(metric, ...)) silently returns None on a "
+        "typo, so those keys must resolve too.")
 
     def check(self, src: SourceFile) -> List[Finding]:
-        return [self.finding(
+        found = [self.finding(
             src, line,
             f"SLO rule metric: {reason} — must be a "
             "CANONICAL_METRIC_NAMES entry or a sparkdl.health.<event> "
             "mirror of a core/health.py constant")
             for line, reason in bad_slo_rule_metrics(src.tree)]
+        found.extend(self.finding(
+            src, line,
+            f"windowed-metrics lookup: {reason} — frame and snapshot "
+            "sections are keyed by declared metric names")
+            for line, reason in bad_frame_metric_keys(src.tree))
+        return found
 
 
 # ---------------------------------------------------------------------------

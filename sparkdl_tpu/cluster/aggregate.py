@@ -26,12 +26,17 @@ worker without dragging in jax.
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Sequence
+import bisect
+import math
+import threading
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from sparkdl_tpu.core import telemetry
 
 __all__ = ["build_snapshot", "merge_snapshots", "merged_run_report",
-           "sum_canonical_counters", "sum_health_counters"]
+           "sum_canonical_counters", "sum_health_counters",
+           "build_frame", "ClusterMetricsView"]
 
 
 def build_snapshot(worker: str, pid: int, tel: Any, monitor: Any, *,
@@ -85,8 +90,56 @@ def _tenant_section(metrics: Dict[str, Any]) -> Dict[str, Any]:
                 "count": hist.get("count", 0),
                 "sum_s": hist.get("sum", 0.0),
                 "p99_s": hist.get("p99"),
+                # the raw per-bucket counts (plus the observed envelope)
+                # ride along so the coordinator's merge can estimate the
+                # CLUSTER p99 from one merged bucket array instead of
+                # taking the worst worker's estimate
+                "buckets": hist.get("buckets") or {},
+                "min_s": hist.get("min"),
+                "max_s": hist.get("max"),
             }
     return dict(sorted(out.items()))
+
+
+def _merged_bucket_percentile(views: Sequence[Dict[str, Any]],
+                              q: float = 0.99) -> Optional[float]:
+    """Estimate one percentile over the SUM of several workers' bucket
+    dicts (``Histogram.snapshot()`` format: per-bucket counts keyed by
+    the ``repr`` of the upper bound, ``"+Inf"`` for overflow), assuming
+    the default time ladder. Returns ``None`` when any view lacks
+    buckets or carries a bound off the ladder — the caller falls back
+    to the worst-worker estimate rather than merging unlike ladders."""
+    bounds = telemetry.DEFAULT_TIME_BOUNDS
+    counts = [0] * (len(bounds) + 1)
+    count = 0
+    vmin: Optional[float] = None
+    vmax: Optional[float] = None
+    for view in views:
+        buckets = view.get("buckets")
+        if not buckets:
+            if view.get("count"):
+                return None  # samples without bucket data: cannot merge
+            continue
+        for key, c in buckets.items():
+            if key == "+Inf":
+                idx = len(bounds)
+            else:
+                try:
+                    bound = float(key)
+                except (TypeError, ValueError):
+                    return None
+                idx = bisect.bisect_left(bounds, bound)
+                if idx >= len(bounds) or bounds[idx] != bound:
+                    return None  # off-ladder bound: unmergeable
+            counts[idx] += int(c)
+            count += int(c)
+        lo, hi = view.get("min_s"), view.get("max_s")
+        if lo is not None:
+            vmin = lo if vmin is None else min(vmin, lo)
+        if hi is not None:
+            vmax = hi if vmax is None else max(vmax, hi)
+    return telemetry._estimate_percentile(q, counts, count, bounds,
+                                          vmin, vmax)
 
 
 def sum_canonical_counters(snapshots: Sequence[Dict[str, Any]]
@@ -187,16 +240,28 @@ def merge_snapshots(snapshots: Sequence[Dict[str, Any]],
             "span_rings_lost": sorted(lost_workers),
         }
     tenants: Dict[str, Dict[str, Any]] = {}
+    tenant_views: Dict[str, List[Dict[str, Any]]] = {}
     for s in snapshots:
         for tenant, view in (s.get("tenants") or {}).items():
             agg = tenants.setdefault(
-                tenant, {"count": 0, "sum_s": 0.0, "p99_s": None})
+                tenant, {"count": 0, "sum_s": 0.0, "p99_s": None,
+                         "p99_worst_worker": None})
             agg["count"] += view.get("count", 0)
             agg["sum_s"] = round(agg["sum_s"] + view.get("sum_s", 0.0), 9)
             p99 = view.get("p99_s")
-            if p99 is not None and (agg["p99_s"] is None
-                                    or p99 > agg["p99_s"]):
-                agg["p99_s"] = p99
+            if p99 is not None and (agg["p99_worst_worker"] is None
+                                    or p99 > agg["p99_worst_worker"]):
+                agg["p99_worst_worker"] = p99
+            tenant_views.setdefault(tenant, []).append(view)
+    for tenant, agg in tenants.items():
+        # the cluster p99 is a REAL merged percentile (bucket counts
+        # summed across workers, one estimate over the sum); the old
+        # worst-worker value stays published as p99_worst_worker for one
+        # release of comparability, and is the fallback when a worker
+        # shipped no bucket data to merge
+        merged = _merged_bucket_percentile(tenant_views[tenant], q=0.99)
+        agg["p99_s"] = (merged if merged is not None
+                        else agg["p99_worst_worker"])
     if tenants:
         out["tenants"] = dict(sorted(tenants.items()))
     serving_workers = {s["worker"]: s["serving"] for s in snapshots
@@ -244,3 +309,347 @@ def merged_run_report(tel: Any, snapshots: Sequence[Dict[str, Any]],
                                         lost_workers=lost_workers,
                                         autoscale_events=autoscale_events)
     return report
+
+
+# ---------------------------------------------------------------------------
+# Live metrics federation (docs/OBSERVABILITY.md "Cluster metrics
+# federation"): workers ship bounded windowed-metrics frames at the
+# federation cadence; the coordinator folds them into ONE live view.
+# ---------------------------------------------------------------------------
+
+
+def build_frame(worker: str, wid: int, seq: int, tel: Any,
+                clock_offset_ns: int = 0) -> Optional[Dict[str, Any]]:
+    """One worker's metrics-federation frame (worker-side, between
+    tasks): ``MetricsRegistry.export_frame()``'s canonical-name-filtered
+    ring export plus the worker identity, a per-worker frame sequence
+    number, and the clock-handshake offset the coordinator needs to
+    rebase the slot epochs onto its own clock. ``None`` when the
+    worker's registry has no windows (nothing to federate)."""
+    frame = tel.metrics.export_frame()
+    if frame is None:
+        return None
+    frame["worker"] = worker
+    frame["wid"] = wid
+    frame["seq"] = seq
+    frame["clock_offset_ns"] = int(clock_offset_ns)
+    return frame
+
+
+class ClusterMetricsView:
+    """The coordinator's live fold of worker metrics frames.
+
+    Each :func:`build_frame` payload is the full state of one worker's
+    metric rings (merge-by-replace per worker: a dropped frame heals on
+    the next cadence). The fold happens at QUERY time —
+    :meth:`window_snapshot` walks the retained frames, rebases every
+    slot epoch onto the coordinator's clock (the per-worker slot shift
+    is ``round(clock_offset / slot_span)``, from the PR 15 clock
+    handshake, so a skewed worker's samples land in the coordinator
+    slots they actually happened in: no double-count, no gap), sums
+    counters, merges gauge envelopes, and SUMS histogram bucket arrays
+    per slot — a cluster p99 is one estimate over the merged buckets,
+    not a worst-worker guess.
+
+    Staleness: a worker whose last frame is older than
+    ``stale_factor × cadence_s`` — or that the router marked dead — is
+    aged OUT of the fold, and ``workers_reporting`` says so explicitly.
+    Its last frame is retained (not folded) so a postmortem bundle can
+    still show the dead worker's final shipped state.
+
+    The view quacks like a :class:`telemetry.MetricsRegistry` for the
+    SLO watchdog: ``window_snapshot(window_s)`` returns the exact
+    windowed shape ``SLOWatchdog.evaluate`` consumes, so a plain
+    watchdog evaluates cluster-level rules against it unchanged.
+
+    Thread-safe: the router's collector ingests while the exporter
+    thread (and tests) query.
+    """
+
+    #: Exemplars kept per merged histogram window (the per-worker
+    #: reservoirs are already tiny; the merge keeps the global tail).
+    MERGED_EXEMPLAR_K = 8
+
+    def __init__(self, cadence_s: float, stale_factor: float = 3.0,
+                 timeline_max: int = 240) -> None:
+        if cadence_s <= 0:
+            raise ValueError(
+                f"federation cadence_s must be > 0, got {cadence_s!r}")
+        self.cadence_s = float(cadence_s)
+        self.stale_after_s = float(stale_factor) * self.cadence_s
+        self._lock = threading.Lock()
+        # worker name -> {frame, shift, last_seen, alive}
+        self._workers: Dict[str, Dict[str, Any]] = {}
+        self._timeline: "deque[Dict[str, Any]]" = deque(
+            maxlen=timeline_max)
+        self.frames_ingested = 0
+        self.window_s: Optional[float] = None  # ring capacity, learned
+
+    # -- ingest (collector thread) -------------------------------------------
+
+    def ingest(self, frame: Dict[str, Any],
+               now: Optional[float] = None) -> None:
+        """Fold one worker frame in (merge-by-replace for that worker).
+        The slot shift is computed once here from the frame's shipped
+        clock offset; sub-slot skew is absorbed by round-to-nearest."""
+        span = float(frame.get("span_s") or 0.0)
+        slots = int(frame.get("slots") or 0)
+        worker = frame.get("worker")
+        if span <= 0 or slots <= 0 or not worker:
+            return
+        if now is None:
+            now = telemetry._monotonic()
+        offset_s = float(frame.get("clock_offset_ns") or 0) / 1e9
+        shift = int(math.floor(offset_s / span + 0.5))
+        with self._lock:
+            self._workers[worker] = {
+                "frame": frame, "shift": shift, "last_seen": now,
+                "alive": True}
+            self.frames_ingested += 1
+            self.window_s = span * slots
+
+    def mark_dead(self, worker: str) -> None:
+        """Age a dead worker out of the fold immediately (its pipe hit
+        EOF — no more frames are coming); the last frame is retained
+        for the flight recorder."""
+        with self._lock:
+            entry = self._workers.get(worker)
+            if entry is not None:
+                entry["alive"] = False
+
+    # -- accounting ----------------------------------------------------------
+
+    def _fresh_locked(self, now: float) -> List[Dict[str, Any]]:
+        return [e for e in self._workers.values()
+                if e["alive"] and now - e["last_seen"] <= self.stale_after_s]
+
+    def workers_reporting(self, now: Optional[float] = None) -> int:
+        """Workers currently IN the fold: alive (no EOF) and fresh
+        (frame newer than the staleness horizon)."""
+        if now is None:
+            now = telemetry._monotonic()
+        with self._lock:
+            return len(self._fresh_locked(now))
+
+    def fresh_workers(self, now: Optional[float] = None) -> List[str]:
+        """The names behind :meth:`workers_reporting` — the router's
+        collector diffs consecutive calls to emit one
+        ``cluster_metrics_stale`` event per worker leaving the fold."""
+        if now is None:
+            now = telemetry._monotonic()
+        with self._lock:
+            return sorted(w for w, e in self._workers.items()
+                          if e["alive"]
+                          and now - e["last_seen"] <= self.stale_after_s)
+
+    def last_frames(self) -> Dict[str, Dict[str, Any]]:
+        """Every retained frame (fresh, stale, AND dead workers') with
+        its accounting — the flight recorder's raw material."""
+        with self._lock:
+            return {w: {"frame": e["frame"], "alive": e["alive"],
+                        "last_seen": e["last_seen"]}
+                    for w, e in sorted(self._workers.items())}
+
+    # -- the fold ------------------------------------------------------------
+
+    def window_snapshot(self, window_s: Optional[float] = None,
+                        now: Optional[float] = None) -> Dict[str, Any]:
+        """The federated windowed view, in ``MetricsRegistry.
+        window_snapshot`` shape (plus ``workers_reporting``) so the SLO
+        watchdog, the autoscaler, and the exporter consume it like a
+        local registry."""
+        if now is None:
+            now = telemetry._monotonic()
+        with self._lock:
+            entries = self._fresh_locked(now)
+            reporting = len(entries)
+            cap = self.window_s
+        if window_s is None:
+            window_s = cap
+        if cap is not None and window_s is not None:
+            window_s = min(float(window_s), cap)
+        out = self._fold(entries, window_s, now)
+        out["workers_reporting"] = reporting
+        return out
+
+    def attribution(self, metric: str, stat: str,
+                    window_s: Optional[float] = None,
+                    now: Optional[float] = None) -> Dict[str, Any]:
+        """Per-worker observed values for one metric/stat over the
+        window — what a federated breach event carries so the operator
+        sees WHICH workers drove the cluster-wide verdict."""
+        if now is None:
+            now = telemetry._monotonic()
+        with self._lock:
+            entries = {w: e for w, e in sorted(self._workers.items())
+                       if e["alive"]
+                       and now - e["last_seen"] <= self.stale_after_s}
+        out: Dict[str, Any] = {}
+        for worker, entry in entries.items():
+            folded = self._fold([entry], window_s, now)
+            hist = folded["histograms"].get(metric)
+            ctr = folded["counters"].get(metric)
+            gauge = folded["gauges"].get(metric)
+            if hist is not None and stat in hist:
+                out[worker] = hist[stat]
+            elif ctr is not None and stat in ctr:
+                out[worker] = ctr[stat]
+            elif gauge is not None and stat == "value":
+                out[worker] = gauge["last"]
+            else:
+                out[worker] = None
+        return out
+
+    def _fold(self, entries: Sequence[Dict[str, Any]],
+              window_s: Optional[float], now: float) -> Dict[str, Any]:
+        if not entries or not window_s or window_s <= 0:
+            return {"window_s": window_s if window_s else None,
+                    "counters": {}, "gauges": {}, "histograms": {}}
+        span = float(entries[0]["frame"]["span_s"])
+        slots = int(entries[0]["frame"]["slots"])
+        # the coordinator-clock window floor — the same arithmetic as
+        # telemetry._window_floor, but over the query clock so fakes in
+        # tests drive it deterministically
+        k = min(slots, max(1, math.ceil(window_s / span)))
+        floor = int(now / span) - k + 1
+        counters: Dict[str, int] = {}
+        gauges: Dict[str, List[Tuple[int, List[float]]]] = {}
+        hists: Dict[str, Dict[str, Any]] = {}
+        for entry in entries:
+            frame, shift = entry["frame"], entry["shift"]
+            for name, per_slot in (frame.get("counters") or {}).items():
+                for epoch, c in per_slot.items():
+                    if int(epoch) + shift >= floor:
+                        counters[name] = counters.get(name, 0) + int(c)
+            for name, per_slot in (frame.get("gauges") or {}).items():
+                for epoch, env in per_slot.items():
+                    if int(epoch) + shift >= floor:
+                        gauges.setdefault(name, []).append(
+                            (int(epoch) + shift, list(env)))
+            for name, hist in (frame.get("histograms") or {}).items():
+                bounds = tuple(float(b) for b in hist.get("bounds") or ())
+                agg = hists.setdefault(name, {
+                    "bounds": bounds,
+                    "counts": [0] * (len(bounds) + 1),
+                    "count": 0, "sum": 0.0, "min": None, "max": None,
+                    "exemplars": []})
+                if agg["bounds"] != bounds:
+                    continue  # unlike ladders never merge
+                for epoch, slot in (hist.get("slots") or {}).items():
+                    if int(epoch) + shift < floor:
+                        continue
+                    bucket_counts, cnt, total, lo, hi = slot[:5]
+                    for j, c in enumerate(bucket_counts):
+                        agg["counts"][j] += c
+                    agg["count"] += cnt
+                    agg["sum"] += total
+                    if lo is not None:
+                        agg["min"] = (lo if agg["min"] is None
+                                      else min(agg["min"], lo))
+                    if hi is not None:
+                        agg["max"] = (hi if agg["max"] is None
+                                      else max(agg["max"], hi))
+                    if len(slot) > 5:
+                        agg["exemplars"].extend(
+                            tuple(ex) for ex in slot[5])
+        out_counters = {
+            name: {"count": c, "rate_per_s": round(c / window_s, 9)}
+            for name, c in sorted(counters.items())}
+        out_gauges: Dict[str, Any] = {}
+        for name, seen in sorted(gauges.items()):
+            seen.sort(key=lambda ev: ev[0])
+            out_gauges[name] = {
+                "last": seen[-1][1][0],
+                "min": min(env[1] for _, env in seen),
+                "max": max(env[2] for _, env in seen)}
+        out_hists: Dict[str, Any] = {}
+        for name, agg in sorted(hists.items()):
+            count = agg["count"]
+            snap = {
+                "count": count,
+                "sum": round(agg["sum"], 9),
+                "rate_per_s": round(count / window_s, 9),
+                "min": agg["min"], "max": agg["max"],
+            }
+            for stat, q in (("p50", 0.50), ("p95", 0.95), ("p99", 0.99)):
+                snap[stat] = telemetry._estimate_percentile(
+                    q, agg["counts"], count, agg["bounds"],
+                    agg["min"], agg["max"])
+            if agg["exemplars"]:
+                exemplars = sorted(agg["exemplars"], reverse=True)
+                snap["exemplars"] = [
+                    {"value": v, "trace_id": t, "span_id": s}
+                    for v, t, s in exemplars[:self.MERGED_EXEMPLAR_K]]
+            out_hists[name] = snap
+        return {"window_s": float(window_s), "counters": out_counters,
+                "gauges": out_gauges, "histograms": out_hists}
+
+    # -- the bounded timeline the flight recorder dumps ----------------------
+
+    def note_timeline(self, entry: Dict[str, Any]) -> None:
+        with self._lock:
+            self._timeline.append(entry)
+
+    def timeline(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._timeline)
+
+    # -- exporter integration ------------------------------------------------
+
+    def status(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """The compact per-tick view the coordinator's snapshot exporter
+        embeds (``cluster`` key of each JSONL line): accounting plus the
+        non-empty folded instruments."""
+        if now is None:
+            now = telemetry._monotonic()
+        snap = self.window_snapshot(now=now)
+        with self._lock:
+            known = len(self._workers)
+            ingested = self.frames_ingested
+        return {
+            "workers_reporting": snap["workers_reporting"],
+            "workers_known": known,
+            "frames_ingested": ingested,
+            "window_s": snap["window_s"],
+            "counters": {k: v for k, v in snap["counters"].items()
+                         if v["count"]},
+            "gauges": snap["gauges"],
+            "histograms": {
+                k: {"count": v["count"], "p50": v["p50"],
+                    "p99": v["p99"]}
+                for k, v in snap["histograms"].items() if v["count"]},
+        }
+
+    def prometheus_text(self, now: Optional[float] = None) -> str:
+        """Federated Prometheus series (``sparkdl_cluster_*`` prefix so
+        they never collide with the coordinator's local families): the
+        merged windowed percentiles/rates plus the reporting gauge —
+        what makes a live scrape of the coordinator reflect the whole
+        cluster."""
+        import re as _re
+
+        snap = self.window_snapshot(now=now)
+        lines: List[str] = []
+
+        def family(name: str, kind: str) -> str:
+            n = "sparkdl_cluster:" + _re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+            lines.append(f"# HELP {n} federated cluster view of {name} "
+                         f"(sparkdl_tpu {kind})")
+            lines.append(f"# TYPE {n} {kind}")
+            return n
+
+        n = family("workers_reporting", "gauge")
+        lines.append(f"{n} {snap['workers_reporting']}")
+        for name, view in snap["counters"].items():
+            n = family(name + ":window_rate_per_s", "gauge")
+            lines.append(f"{n} {view['rate_per_s']}")
+        for name, view in snap["gauges"].items():
+            n = family(name, "gauge")
+            lines.append(f"{n} {view['last']}")
+        for name, view in snap["histograms"].items():
+            for stat in ("p50", "p99"):
+                if view[stat] is None:
+                    continue
+                n = family(f"{name}:window_{stat}", "gauge")
+                lines.append(f"{n} {view[stat]}")
+        return "\n".join(lines) + "\n"

@@ -70,6 +70,12 @@ from sparkdl_tpu.core import durability, health, resilience, telemetry
 
 logger = logging.getLogger(__name__)
 
+# Flight-recorder bounds: how long a postmortem waits for on-demand
+# span-ring pulls before bundling what it has, and how many bundles one
+# router will write (a breach storm must not fill the disk).
+_POSTMORTEM_RING_WAIT_S = 2.0
+_POSTMORTEM_MAX = 8
+
 # One spawn context for every router (module-level so the
 # thread-lifecycle analyzer rule can resolve `_MP_CTX.Process(...)`).
 _MP_CTX = mp.get_context("spawn")
@@ -202,7 +208,9 @@ class ClusterRouter:
 
     def __init__(self, workers: int, inflight: Optional[int] = None,
                  run_id: Optional[str] = None,
-                 autoscale: Optional[bool] = None) -> None:
+                 autoscale: Optional[bool] = None,
+                 federation_s: Optional[float] = None,
+                 federation_rules: Optional[Sequence[Any]] = None) -> None:
         if workers < 1:
             raise ValueError(
                 f"cluster router needs >= 1 worker, got {workers}")
@@ -240,7 +248,12 @@ class ClusterRouter:
         # None (tracing off) keeps the worker's trace fully local
         self._boot_blob = cloudpickle.dumps(
             {"config": config, "platform": jax.default_backend(),
-             "root_ctx": tel.root_context if tel is not None else None})
+             "root_ctx": tel.root_context if tel is not None else None,
+             # exemplar reservoirs are per-registry opt-in: workers arm
+             # the SAME k as the coordinator, or federated breach events
+             # would lose their resolvable exemplar trace ids
+             "exemplar_k": (tel.metrics.exemplar_k
+                            if tel is not None else 0)})
         self._lock = threading.Lock()
         # the attached cluster serving handler (serving/cluster.py), or
         # None while the serving plane is off — srv_* replies, precise
@@ -266,6 +279,36 @@ class ClusterRouter:
         self.autoscale_events: List[Dict[str, Any]] = []
         self._autoscale_stop = threading.Event()
         self._autoscale_thread: Optional[threading.Thread] = None
+        # -- metrics federation (docs/OBSERVABILITY.md "Cluster metrics
+        # federation") — armed by EngineConfig.cluster_federation_s:
+        # workers ship windowed delta frames on that cadence; the
+        # collector folds them into the ClusterMetricsView and drives
+        # the federated SLO watchdog against the merged fold
+        fed_s = (EngineConfig.cluster_federation_s
+                 if federation_s is None else federation_s)
+        self._fed_view: Optional[aggregate.ClusterMetricsView] = None
+        self._fed_watchdog: Optional[Any] = None
+        self._fed_breached: Set[str] = set()
+        self._fed_fresh: Set[str] = set()
+        if fed_s:
+            from sparkdl_tpu.core import slo as _slo
+
+            self._fed_view = aggregate.ClusterMetricsView(float(fed_s))
+            rules = (list(federation_rules)
+                     if federation_rules is not None
+                     else _default_federation_rules())
+            self._fed_watchdog = _slo.SLOWatchdog(
+                rules, attribution=self._fed_attribution)
+        # flight recorder: breach/death/FATAL-triggered postmortem
+        # bundles, written on short-lived daemon threads (the collector
+        # must keep draining pipes — the bundle pulls span rings over
+        # those same pipes, so writing in-collector would deadlock)
+        self._pm_lock = threading.Lock()
+        self._pm_seq = 0
+        self._pm_threads: List[threading.Thread] = []
+        self.postmortem_paths: List[str] = []
+        self._ring_cond = threading.Condition()
+        self._ring_box: Dict[int, Dict[str, Any]] = {}
         # bench accounting: wall time inside dispatch vs worker-measured
         # op-chain time (their gap is the router's overhead)
         self.dispatch_s_total = 0.0
@@ -662,6 +705,18 @@ class ClusterRouter:
             if handler is not None:
                 handler.on_message(worker.wid, msg)
             return
+        if kind == "frame":
+            # windowed metrics delta frame (the federation cadence):
+            # fold it, then judge the merged fold
+            self._on_frame(worker, msg[2])
+            return
+        if kind == "ring":
+            # on-demand span-ring pull reply: route to the waiting
+            # flight-recorder thread
+            with self._ring_cond:
+                self._ring_box[worker.wid] = msg[2]
+                self._ring_cond.notify_all()
+            return
         if kind == "final":
             with self._lock:
                 worker.finished = True
@@ -696,6 +751,14 @@ class ClusterRouter:
         else:
             _, _, type_name, message, err_kind = msg
             task.error = _rebuild_error(type_name, message, err_kind)
+            if err_kind == resilience.FATAL:
+                # a FATAL task failure is a flight-recorder trigger: the
+                # postmortem captures the cluster state AT the failure,
+                # not whatever remains at end of run
+                self._trigger_postmortem(
+                    "fatal_task",
+                    {"partition": task.index, "worker": worker.proc.name,
+                     "error": f"{type_name}: {message}"})
         task.event.set()
         self._sem.release()
         self._gauge(total)
@@ -859,6 +922,18 @@ class ClusterRouter:
                 worker.proc.name, len(redispatched), len(failed))
             health.record(health.CLUSTER_WORKER_LOST,
                           worker=worker.proc.name)
+            view = self._fed_view
+            if view is not None:
+                # age the dead worker out of the federated fold NOW (no
+                # more frames are coming) — its last shipped frame stays
+                # retained for the postmortem bundle
+                view.mark_dead(worker.proc.name)
+                self._fed_fresh.discard(worker.proc.name)
+                health.record(health.CLUSTER_METRICS_STALE,
+                              worker=worker.proc.name,
+                              reason="worker_lost")
+                self._trigger_postmortem(
+                    "worker_lost", {"worker": worker.proc.name})
             for task in redispatched:
                 health.record(health.CLUSTER_REDISPATCH,
                               partition=task.index,
@@ -872,6 +947,170 @@ class ClusterRouter:
             handler = self._serving
             if handler is not None:
                 handler.on_worker_lost(worker.wid, srv_lost)
+
+    # -- metrics federation + the flight recorder -----------------------------
+
+    def _fed_attribution(self, rule: Any) -> Dict[str, Any]:
+        """Per-worker observed values behind a federated breach (the
+        SLOWatchdog attribution hook): which workers drove the merged
+        verdict."""
+        view = self._fed_view
+        if view is None:
+            return {}
+        return view.attribution(rule.metric, rule.stat, rule.window_s)
+
+    def _on_frame(self, worker: _Worker, frame: Dict[str, Any]) -> None:
+        """Fold one worker's delta frame into the federated view, then
+        evaluate the cluster SLO watchdog against the merged fold.
+        Collector thread only — the watchdog's hold-down state is
+        single-threaded by construction. A rule newly ENTERING breach
+        trips the flight recorder (recoveries and still-breached rules
+        do not: one bundle per incident, not per frame)."""
+        view = self._fed_view
+        if view is None:
+            return
+        view.ingest(frame)
+        now = telemetry._monotonic()
+        fresh = set(view.fresh_workers(now))
+        for name in sorted(self._fed_fresh - fresh):
+            # a worker stopped shipping frames without dying (wedged, or
+            # a cadence stall): it silently left the fold — say so once
+            health.record(health.CLUSTER_METRICS_STALE, worker=name,
+                          reason="frames_stale")
+        # sparkdl: allow(unguarded-shared-write): collector-thread-only state (_on_frame and _on_worker_eof both run on the collector) — single writer by construction
+        self._fed_fresh = fresh
+        wd = self._fed_watchdog
+        if wd is None:
+            return
+        verdicts = wd.evaluate(view, now=now)
+        active = {name for name, v in verdicts.items() if v["breached"]}
+        view.note_timeline({
+            "t": now, "workers_reporting": len(fresh),
+            "slo": {name: {"observed": v["observed"],
+                           "breached": v["breached"]}
+                    for name, v in verdicts.items()
+                    if v["observed"] is not None or v["breached"]}})
+        for name in sorted(active - self._fed_breached):
+            self._trigger_postmortem(
+                "slo_breach", {"rule": name, **verdicts[name]})
+        # sparkdl: allow(unguarded-shared-write): collector-thread-only state — single writer by construction
+        self._fed_breached = active
+
+    def _trigger_postmortem(self, trigger: str,
+                            detail: Dict[str, Any]) -> None:
+        """Arm one postmortem bundle write on a daemon thread. No
+        federation, no active telemetry scope with an ``out_dir``,
+        router closed, or the per-run bundle cap reached: no-op — the
+        flight recorder never introduces artifacts (or blocking) into
+        runs that didn't opt into observability."""
+        if self._fed_view is None or self._closed:
+            return
+        tel = telemetry.active()
+        out_dir = tel.out_dir if tel is not None else None
+        if not out_dir:
+            return
+        with self._lock:
+            if self._pm_seq >= _POSTMORTEM_MAX or self._closed:
+                return
+            self._pm_seq += 1
+            seq = self._pm_seq
+        recorder = threading.Thread(
+            target=self._write_postmortem,
+            args=(seq, trigger, dict(detail), out_dir),
+            name=f"sparkdl-flight-recorder-{seq}", daemon=True)
+        recorder.start()
+        with self._lock:
+            self._pm_threads.append(recorder)
+
+    def _pull_rings(self) -> List[Dict[str, Any]]:
+        """Fan an on-demand span-ring pull to every live worker and
+        wait (bounded) for the replies — the collector routes each
+        ``("ring", wid, ring)`` answer into the box. A worker that dies
+        or stalls mid-pull just misses the bundle; the recorder ships
+        what it has."""
+        with self._lock:
+            if self._closed:
+                return []
+            live = [w for w in self._workers
+                    if not w.lost and not w.finished and not w.pilled]
+            with self._ring_cond:
+                self._ring_box = {}
+            expect: Set[int] = set()
+            for w in live:
+                try:
+                    w.queue.put(("pull_ring",))
+                    expect.add(w.wid)
+                except ValueError:  # queue reaped concurrently
+                    pass
+        deadline = time.monotonic() + _POSTMORTEM_RING_WAIT_S
+        with self._ring_cond:
+            while not expect <= set(self._ring_box):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                # sparkdl: allow(wait-holding-lock): the foreign lock is _pm_lock, the flight recorder's own serialization lock — only recorder threads take it, the wait is deadline-bounded, and no hot path can contend
+                self._ring_cond.wait(remaining)
+            return list(self._ring_box.values())
+
+    def _write_postmortem(self, seq: int, trigger: str,
+                          detail: Dict[str, Any], out_dir: str) -> None:
+        try:
+            self._write_postmortem_inner(seq, trigger, detail, out_dir)
+        # sparkdl: allow(broad-retry): not a retry — the flight recorder is best-effort diagnostics and must never fail the run it is documenting
+        except Exception:  # noqa: BLE001
+            logger.exception("postmortem bundle %d failed; continuing",
+                             seq)
+
+    def _write_postmortem_inner(self, seq: int, trigger: str,
+                                detail: Dict[str, Any],
+                                out_dir: str) -> None:
+        """One postmortem bundle: merged partial Chrome trace (live
+        span-ring pulls), the last-K federated timeline, the health
+        report, and the trigger's breach record — staged in a tmp dir
+        and renamed into place, so ``postmortem_<run_id>_<seq>/`` is
+        only ever observed complete."""
+        import json
+
+        # sparkdl: allow(wait-holding-lock): _pm_lock is the flight recorder's own serialization lock (only recorder threads ever take it) — holding it across the bounded ring wait is exactly its job; no hot path can contend
+        with self._pm_lock:  # serialize pulls: the ring box is shared
+            rings = self._pull_rings()
+        view = self._fed_view
+        tel = telemetry.active()
+        bundle = f"postmortem_{self.run_id}_{seq:04d}"
+        final_dir = os.path.join(out_dir, bundle)
+        tmp_dir = final_dir + ".tmp"
+        os.makedirs(tmp_dir, exist_ok=True)
+        if tel is not None:
+            trace = tel.tracer.merged_chrome_trace(rings)
+            with open(os.path.join(tmp_dir, "trace.json"), "w",
+                      encoding="utf-8") as f:
+                json.dump(trace, f)
+        if view is not None:
+            with open(os.path.join(tmp_dir, "snapshots.jsonl"), "w",
+                      encoding="utf-8") as f:
+                for line in view.timeline():
+                    f.write(json.dumps(line, default=repr) + "\n")
+        mon = health.active_monitor()
+        with open(os.path.join(tmp_dir, "health.json"), "w",
+                  encoding="utf-8") as f:
+            json.dump(mon.report() if mon is not None else None, f,
+                      indent=2, default=repr)
+        breach: Dict[str, Any] = {
+            "trigger": trigger, "detail": detail,
+            "run_id": self.run_id, "seq": seq,
+            "rings_pulled": len(rings)}
+        if view is not None:
+            breach["federation"] = view.last_frames()
+        with open(os.path.join(tmp_dir, "breach.json"), "w",
+                  encoding="utf-8") as f:
+            json.dump(breach, f, indent=2, default=repr)
+        os.rename(tmp_dir, final_dir)
+        with self._lock:
+            self.postmortem_paths.append(final_dir)
+        health.record(health.POSTMORTEM_DUMPED, trigger=trigger,
+                      path=final_dir, seq=seq)
+        logger.warning("flight recorder wrote postmortem bundle %s (%s)",
+                       final_dir, trigger)
 
     # -- the autoscaler -------------------------------------------------------
 
@@ -903,12 +1142,21 @@ class ClusterRouter:
         EngineConfig.validate()
         now = time.monotonic() if now is None else now
         p99: Optional[float] = None
-        tel = telemetry.active()
-        if tel is not None:
-            snap = tel.metrics.window_snapshot(
-                EngineConfig.autoscale_window_s)
-            hist = snap["histograms"].get(telemetry.M_QUEUE_WAIT_S)
+        view = self._fed_view
+        if view is not None:
+            # federation armed: scale on the CLUSTER queue-wait p99 (the
+            # merged-bucket fold over every reporting worker), not just
+            # whatever the coordinator-local registry happened to see
+            fed = view.window_snapshot(EngineConfig.autoscale_window_s)
+            hist = fed["histograms"].get(telemetry.M_QUEUE_WAIT_S)
             p99 = hist.get("p99") if hist else None
+        if p99 is None:
+            tel = telemetry.active()
+            if tel is not None:
+                snap = tel.metrics.window_snapshot(
+                    EngineConfig.autoscale_window_s)
+                hist = snap["histograms"].get(telemetry.M_QUEUE_WAIT_S)
+                p99 = hist.get("p99") if hist else None
         stuck: List[_Worker] = []
         with self._lock:
             if self._closed:
@@ -1020,6 +1268,13 @@ class ClusterRouter:
         self._collector.join()
         if self._autoscale_thread is not None:
             self._autoscale_thread.join(timeout=_JOIN_TIMEOUT_S)
+        with self._lock:
+            recorders = list(self._pm_threads)
+        for recorder in recorders:
+            # in-flight postmortem bundles finish (their ring waits are
+            # bounded) before the reports merge — a bundle must land
+            # BEFORE the run ends, never race interpreter teardown
+            recorder.join(timeout=_JOIN_TIMEOUT_S)
         for task in abandoned:
             task.error = resilience.ClusterWorkerLost(
                 "cluster router closed mid-stream")
@@ -1054,6 +1309,15 @@ class ClusterRouter:
             aggregate.merged_run_report(tel, finals, lost_workers=lost,
                                         autoscale_events=scale_events)
             if tel is not None else None)
+        view = self._fed_view
+        if view is not None:
+            fed_sec = view.status()
+            with self._lock:
+                fed_sec["postmortems"] = list(self.postmortem_paths)
+            self.cluster_report["federation"] = fed_sec
+            if self.run_report is not None:
+                self.run_report.setdefault(
+                    "cluster", {})["federation"] = fed_sec
         if handler is not None:
             # the coordinator-side router view (replica map, failover
             # tallies, cutovers) joins the worker-side serving stats the
@@ -1085,8 +1349,51 @@ class ClusterRouter:
 
 _router_lock = threading.Lock()
 _router: Optional[ClusterRouter] = None
-_router_key: Optional[Tuple[int, Optional[int], bool]] = None
+_router_key: Optional[Tuple[int, Optional[int], bool,
+                            Optional[float]]] = None
 _last_router: Optional[ClusterRouter] = None
+
+
+def _default_federation_rules() -> List[Any]:
+    """The ruleset a router's federated watchdog runs when the caller
+    supplied none: the ``cluster_``-prefixed copies of
+    ``slo.default_rules``. Module-level so tests (and operators with a
+    sitecustomize) can swap the default in ONE place."""
+    from sparkdl_tpu.core import slo as _slo
+
+    return list(_slo.federated_default_rules())
+
+
+def exporter_status() -> Optional[Dict[str, Any]]:
+    """Compact federated-view status for the snapshot exporter's
+    ``cluster`` key — ``None`` unless a LIVE router has federation
+    armed. The exporter probes this via ``sys.modules`` (it never
+    imports the cluster plane), so a run that never armed it emits
+    byte-identical artifacts."""
+    router = _router
+    if router is None or router.closed:
+        return None
+    view = router._fed_view
+    if view is None:
+        return None
+    status = view.status()
+    with router._lock:
+        if router.postmortem_paths:
+            status["postmortems"] = list(router.postmortem_paths)
+    return status
+
+
+def exporter_prometheus_text() -> str:
+    """Federated ``sparkdl_cluster_*`` Prometheus families for the
+    exporter's ``.prom`` file — ``""`` unless a live router has
+    federation armed, so the off-path scrape text is unchanged."""
+    router = _router
+    if router is None or router.closed:
+        return ""
+    view = router._fed_view
+    if view is None:
+        return ""
+    return view.prometheus_text()
 
 
 def maybe_router() -> Optional[ClusterRouter]:
@@ -1104,7 +1411,8 @@ def maybe_router() -> Optional[ClusterRouter]:
     if not workers:
         return None
     key = (workers, EngineConfig.cluster_inflight_partitions,
-           EngineConfig.cluster_autoscale)
+           EngineConfig.cluster_autoscale,
+           EngineConfig.cluster_federation_s)
     global _router, _router_key, _last_router
     with _router_lock:
         stale = _router

@@ -46,6 +46,10 @@ Protocol (parent -> worker queue):
       completes, the drain is zero-recompute; ``tenant`` is the job's
       fair-queueing tag (``EngineConfig.job_tenant``), entered as an
       ``executor.tenant_scope`` around the op chain
+  ``("pull_ring",)``                            flight-recorder span
+      pull: reply with the CURRENT span ring (rebased, non-draining —
+      the worker keeps running) so a mid-run postmortem bundle carries
+      a merged partial trace
   ``None``                                      poison pill
 (worker -> parent pipe):
   ``("ok", task_id, ipc, meta)`` / ``("err", task_id, type, msg, kind)``
@@ -54,6 +58,12 @@ Protocol (parent -> worker queue):
       and pills this worker once its in-flight tasks finish — the
       worker NEVER self-exits on SIGTERM (a task sitting unread in the
       queue could be stranded otherwise; the drain is pill-driven)
+  ``("frame", worker_id, frame)``               metrics-federation frame
+      (``EngineConfig.cluster_federation_s`` armed): the bounded
+      windowed-metrics export ``cluster/aggregate.build_frame`` makes,
+      shipped at the federation cadence between tasks so the
+      coordinator's live fold tracks this worker mid-run
+  ``("ring", worker_id, ring)``                 ``pull_ring`` reply
   ``("final", worker_id, snapshot)``            last message before EOF
       (with tracing armed the snapshot carries this worker's span ring,
       rebased onto the coordinator's clock via the startup handshake on
@@ -173,14 +183,39 @@ def _worker_main(worker_id: int, tasks: Any, conn: Any, owner_pid: int,
     # folds health into reports); out_dir="" suppresses file export —
     # the snapshot ships over the pipe instead
     monitor = health.HealthMonitor(name)
+    # metrics federation (docs/OBSERVABILITY.md "Cluster metrics
+    # federation"): NOT forced off in the restored config — the worker
+    # reads the coordinator's cadence here and ships bounded frames
+    # between tasks; None keeps the loop (and the pipe traffic)
+    # byte-identical to the pre-federation protocol
+    fed_s = EngineConfig.cluster_federation_s
+    frame_seq = 0
+    next_frame = (time.monotonic() + fed_s) if fed_s else None
     with monitor, telemetry.Telemetry(
             name=name, out_dir="", run_id=run_id,
-            process_scope=f"w{worker_id}") as tel:
+            process_scope=f"w{worker_id}",
+            exemplar_k=int(boot.get("exemplar_k") or 0)) as tel:
         # ambient worker spans (compiles, executor launches) parent
         # under the coordinator's root rather than this worker's private
         # root — a no-op when tracing is off (coord_root is None)
         telemetry.attach(coord_root)
+
+        def _ring():
+            remap = ({tel.root_context.span_id: coord_root.span_id}
+                     if coord_root is not None else None)
+            return tel.tracer.export_ring(
+                clock_offset_ns=clock_offset, process=name,
+                parent_remap=remap)
+
         while True:
+            if next_frame is not None and time.monotonic() >= next_frame:
+                frame_seq += 1
+                frame = aggregate.build_frame(
+                    name, worker_id, frame_seq, tel,
+                    clock_offset_ns=clock_offset)
+                if frame is not None:
+                    conn.send(("frame", worker_id, frame))
+                next_frame = time.monotonic() + fed_s
             if preempted["flag"] and not preempted["sent"]:
                 # tell the router we are draining, then KEEP processing:
                 # in-flight and already-queued tasks run to completion
@@ -191,7 +226,14 @@ def _worker_main(worker_id: int, tasks: Any, conn: Any, owner_pid: int,
                               worker=name)
                 conn.send(("draining", worker_id))
             try:
-                msg = tasks.get(timeout=_ORPHAN_POLL_S)
+                timeout = _ORPHAN_POLL_S
+                if next_frame is not None:
+                    # wake for the next frame even while idle (the
+                    # cadence must not stall just because no task came)
+                    timeout = min(timeout,
+                                  max(0.01,
+                                      next_frame - time.monotonic()))
+                msg = tasks.get(timeout=timeout)
             except Empty:
                 if os.getppid() != owner_pid:  # orphaned: owner died hard
                     conn.close()
@@ -202,6 +244,12 @@ def _worker_main(worker_id: int, tasks: Any, conn: Any, owner_pid: int,
             if msg[0] == "ops":
                 _, token, blob = msg
                 ops_cache[token] = cloudpickle.loads(blob)
+                continue
+            if msg[0] == "pull_ring":
+                # flight-recorder pull: ship the CURRENT ring (rebased,
+                # re-parented like the final one) and keep running —
+                # the postmortem must not disturb the stream
+                conn.send(("ring", worker_id, _ring()))
                 continue
             if isinstance(msg[0], str) and msg[0].startswith("srv_"):
                 if serving_plane is None:
@@ -256,12 +304,7 @@ def _worker_main(worker_id: int, tasks: Any, conn: Any, owner_pid: int,
         # onto the coordinator's clock, with spans still hanging off the
         # worker's (never-shipped, still-open) root re-parented onto the
         # coordinator's root
-        span_ring = None
-        if coord_root is not None:
-            span_ring = tel.tracer.export_ring(
-                clock_offset_ns=clock_offset, process=name,
-                parent_remap={tel.root_context.span_id:
-                              coord_root.span_id})
+        span_ring = _ring() if coord_root is not None else None
         snapshot = aggregate.build_snapshot(
             name, os.getpid(), tel, monitor, tasks=tasks_done,
             rows=rows_out, exec_s=exec_s_total,

@@ -118,6 +118,12 @@ CLUSTER_WORKER_DRAINED = "cluster_worker_drained"  # cluster: a draining
 CLUSTER_PREEMPTION_NOTICE = "cluster_preemption_notice"  # cluster: a
                                          # worker reported SIGTERM-with-
                                          # warning (spot-VM preemption)
+CLUSTER_METRICS_STALE = "cluster_metrics_stale"  # cluster: a worker's
+                                         # federation frames aged out of
+                                         # the live fold (stale or dead)
+POSTMORTEM_DUMPED = "postmortem_dumped"  # cluster: the flight recorder
+                                         # wrote a breach/death-triggered
+                                         # postmortem bundle
 TENANT_THROTTLED = "tenant_throttled"    # executor: fair queueing held a
                                          # tenant's requests back while
                                          # another tenant's were released

@@ -178,7 +178,8 @@ class SLOWatchdog:
     exporter's tick lock) drives it.
     """
 
-    def __init__(self, rules: Optional[Sequence[SLORule]] = None) -> None:
+    def __init__(self, rules: Optional[Sequence[SLORule]] = None,
+                 attribution: Optional[Any] = None) -> None:
         self.rules: Tuple[SLORule, ...] = tuple(
             DEFAULT_RULES if rules is None else rules)
         names = [r.name for r in self.rules]
@@ -186,6 +187,12 @@ class SLOWatchdog:
             raise ValueError(f"duplicate SLO rule names: {names}")
         self._states = {r.name: _RuleState() for r in self.rules}
         self._capacity_warned: set = set()
+        # optional per-worker attribution hook (the federated watchdog,
+        # cluster/router.py): called as attribution(rule) when a breach
+        # fires, returning {worker: observed} — the breach event then
+        # names WHICH workers drove the cluster-wide verdict, not just
+        # the merged number
+        self.attribution = attribution
 
     def evaluate(self, registry: "telemetry.MetricsRegistry",
                  now: Optional[float] = None) -> Dict[str, Any]:
@@ -230,6 +237,14 @@ class SLOWatchdog:
                     state.active = True
                     extra = ({"exemplars": exemplars} if exemplars
                              else {})
+                    if self.attribution is not None:
+                        try:
+                            extra["workers"] = self.attribution(rule)
+                        # sparkdl: allow(broad-retry): not a retry — attribution is best-effort enrichment; the breach itself must fire regardless
+                        except Exception:  # noqa: BLE001
+                            logger.exception(
+                                "SLO breach attribution hook failed "
+                                "for rule %r", rule.name)
                     health.record(health.SLO_BREACH, rule=rule.name,
                                   metric=rule.metric, stat=rule.stat,
                                   observed=observed,
@@ -411,3 +426,72 @@ def tenant_queue_wait_rules(tenant_targets: Dict[str, float],
                     window_s=window_s, threshold=float(target_s),
                     comparator=">", stat="p99", for_s=for_s))
     return tuple(rules)
+
+
+# ---------------------------------------------------------------------------
+# Federated variants (docs/OBSERVABILITY.md "Cluster metrics
+# federation"): the SAME objectives evaluated against the coordinator's
+# ClusterMetricsView fold instead of a single process's registry — a
+# cluster p99 rule watches the MERGED percentile, a rate rule the SUMMED
+# rate. Rule names get the cluster_ prefix so a coordinator can run its
+# local watchdog and the federated one side by side without colliding
+# episode state or event attribution.
+# ---------------------------------------------------------------------------
+
+FEDERATED_RULE_PREFIX = "cluster_"
+
+
+def _federated(rules: Sequence[SLORule]) -> Tuple[SLORule, ...]:
+    """Re-name a rule set for federated evaluation (same metrics, same
+    thresholds — the VIEW they evaluate against is what changes)."""
+    return tuple(
+        dataclasses.replace(rule, name=FEDERATED_RULE_PREFIX + rule.name)
+        for rule in rules)
+
+
+def federated_default_rules(window_s: float = DEFAULT_WINDOW_S,
+                            for_s: float = DEFAULT_HOLD_S,
+                            queue_wait_p99_s: float =
+                            DEFAULT_QUEUE_WAIT_P99_S,
+                            shed_rate_per_s: float =
+                            DEFAULT_SHED_RATE_PER_S,
+                            ) -> Tuple[SLORule, ...]:
+    """:func:`default_rules` against the federated view: the queue-wait
+    objective becomes the CLUSTER-merged p99 (bucket counts summed
+    across workers before the estimate), the shed/breaker objectives
+    the cluster-summed counts."""
+    return _federated(default_rules(
+        window_s=window_s, for_s=for_s,
+        queue_wait_p99_s=queue_wait_p99_s,
+        shed_rate_per_s=shed_rate_per_s))
+
+
+def federated_tenant_queue_wait_rules(tenant_targets: Dict[str, float],
+                                      window_s: float = DEFAULT_WINDOW_S,
+                                      for_s: float = DEFAULT_HOLD_S,
+                                      ) -> Tuple[SLORule, ...]:
+    """:func:`tenant_queue_wait_rules` against the federated view: each
+    tenant's objective watches its MERGED cluster-wide p99 (per-tenant
+    series federate like any histogram — same dynamic declare)."""
+    return _federated(tenant_queue_wait_rules(
+        tenant_targets, window_s=window_s, for_s=for_s))
+
+
+def federated_cluster_serving_rules(model_targets:
+                                    Optional[Dict[str, float]] = None,
+                                    window_s: float = DEFAULT_WINDOW_S,
+                                    for_s: float = DEFAULT_HOLD_S,
+                                    request_p99_s: float =
+                                    DEFAULT_SERVING_P99_S,
+                                    shed_rate_per_s: float =
+                                    DEFAULT_SERVING_SHED_RATE_PER_S,
+                                    failover_rate_per_s: float =
+                                    DEFAULT_SERVING_FAILOVER_RATE_PER_S,
+                                    ) -> Tuple[SLORule, ...]:
+    """:func:`cluster_serving_rules` against the federated view —
+    worker-side serving series (replica-local latencies, shed/failover
+    mirrors) fold in beside the coordinator's routed view."""
+    return _federated(cluster_serving_rules(
+        model_targets, window_s=window_s, for_s=for_s,
+        request_p99_s=request_p99_s, shed_rate_per_s=shed_rate_per_s,
+        failover_rate_per_s=failover_rate_per_s))

@@ -659,6 +659,25 @@ class Tracer:
                                "args": {"name": name}})
         return {"traceEvents": events, "displayTimeUnit": "ms"}
 
+    def merged_chrome_trace(self, rings: Sequence[Dict[str, Any]]
+                            ) -> Dict[str, Any]:
+        """A Chrome-trace document merging this ring's CURRENT spans
+        with remote :meth:`export_ring` payloads — WITHOUT mutating this
+        ring. The flight recorder (``cluster/router.py``) dumps mid-run
+        postmortems from on-demand ring pulls; adopting those pulled
+        spans into the live ring would double them when the workers ship
+        their final rings at close. A scratch tracer sharing this
+        tracer's clock epoch does the merge instead (same canonical-name
+        rejection as a real adoption), so the live ring stays
+        untouched."""
+        scratch = Tracer(self.trace_id, max_spans=self.max_spans)
+        scratch._t0_ns = self._t0_ns
+        with self._lock:
+            scratch._spans.extend(dict(s) for s in self._spans)
+        for ring in rings:
+            scratch.adopt_remote_spans(ring.get("spans") or ())
+        return scratch.chrome_trace()
+
 
 # ---------------------------------------------------------------------------
 # Metrics registry
@@ -749,6 +768,22 @@ class Counter:
             return sum(c for e, c in zip(self._w_epochs, self._w_counts)
                        if e >= floor_epoch)
 
+    def window_frame(self) -> Dict[int, int]:
+        """Per-slot ``{epoch: count}`` export of the live ring (one
+        consistent locked copy) — the metrics-federation wire format
+        (docs/OBSERVABILITY.md "Cluster metrics federation"). Epochs are
+        THIS process's monotonic slot indices; the coordinator rebases
+        them onto its own clock with the handshake offset before
+        folding. Empty without a ring."""
+        if self._w_span is None:
+            return {}
+        with self._lock:
+            floor_epoch = _window_floor(
+                self._w_span, len(self._w_counts),
+                self._w_span * len(self._w_counts))
+            return {e: c for e, c in zip(self._w_epochs, self._w_counts)
+                    if e >= floor_epoch and c}
+
     @property
     def value(self) -> int:
         with self._lock:
@@ -808,6 +843,20 @@ class Gauge:
         return {"last": seen[-1][1][0],
                 "min": min(v[1] for _, v in seen),
                 "max": max(v[2] for _, v in seen)}
+
+    def window_frame(self) -> Dict[int, List[float]]:
+        """Per-slot ``{epoch: [last, min, max]}`` envelope export of the
+        live ring — the federation wire format for gauges (see
+        :meth:`Counter.window_frame`). Empty without a ring."""
+        if self._w_span is None:
+            return {}
+        with self._lock:
+            floor_epoch = _window_floor(
+                self._w_span, len(self._w_vals),
+                self._w_span * len(self._w_vals))
+            return {e: list(v) for e, v in zip(self._w_epochs,
+                                               self._w_vals)
+                    if e >= floor_epoch and v is not None}
 
     @property
     def value(self) -> Optional[float]:
@@ -986,6 +1035,33 @@ class Histogram:
                 for v, t, s in exemplars[:self._ex_k]]
         return out
 
+    def window_frame(self) -> Dict[int, List[Any]]:
+        """Per-slot sub-histogram export of the live ring, keyed by slot
+        epoch: ``{epoch: [bucket_counts, count, sum, min, max]}`` (with
+        an armed exemplar reservoir each entry appends its slot's
+        ``[(value, trace_id, span_id), ...]`` list). Mergeable by
+        construction: the coordinator sums bucket counts across workers
+        per rebased epoch, so a cluster percentile is estimated from ONE
+        merged bucket array — not a worst-worker guess. Empty without a
+        ring."""
+        if self._w_span is None:
+            return {}
+        out: Dict[int, List[Any]] = {}
+        with self._lock:
+            floor_epoch = _window_floor(
+                self._w_span, len(self._w_slots),
+                self._w_span * len(self._w_slots))
+            for i, (e, slot) in enumerate(zip(self._w_epochs,
+                                              self._w_slots)):
+                if e < floor_epoch or not slot[1]:
+                    continue
+                entry: List[Any] = [list(slot[0]), slot[1], slot[2],
+                                    slot[3], slot[4]]
+                if self._ex_k:
+                    entry.append([list(ex) for ex in self._w_ex[i]])
+                out[e] = entry
+        return out
+
 
 def escape_label_value(value: Any) -> str:
     """Prometheus text-exposition label-value escaping: backslash,
@@ -1113,6 +1189,60 @@ class MetricsRegistry:
             "gauges": out_gauges,
             "histograms": {k: histograms[k].window_snapshot(window_s)
                            for k in sorted(histograms)},
+        }
+
+    def export_frame(self) -> Optional[Dict[str, Any]]:
+        """The bounded metrics-federation delta frame: every windowed
+        instrument's live ring slots keyed by slot epoch, restricted to
+        the canonical catalog plus the ``sparkdl.health.*`` mirrors (the
+        restriction ``cluster/aggregate.py``'s counter fold already
+        applies — a frame never ships a name the taxonomy lint would
+        reject). ``None`` without windows: there is nothing windowed to
+        federate. Frame size is bounded by construction — ring slots ×
+        bucket counts per instrument, independent of traffic volume —
+        and each frame is the full state-of-ring (idempotent
+        merge-by-replace coordinator-side), so a dropped frame heals on
+        the next cadence instead of leaving a permanent gap."""
+        if self._window is None:
+            return None
+        span, slots = self._window
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            histograms = dict(self._histograms)
+
+        def declared(name: str) -> bool:
+            return (name in CANONICAL_METRIC_NAMES
+                    or name.startswith(HEALTH_METRIC_PREFIX))
+
+        out_counters: Dict[str, Any] = {}
+        for name in sorted(counters):
+            if declared(name):
+                frame = counters[name].window_frame()
+                if frame:
+                    out_counters[name] = frame
+        out_gauges: Dict[str, Any] = {}
+        for name in sorted(gauges):
+            if declared(name):
+                frame = gauges[name].window_frame()
+                if frame:
+                    out_gauges[name] = frame
+        out_hists: Dict[str, Any] = {}
+        for name in sorted(histograms):
+            if declared(name):
+                frame = histograms[name].window_frame()
+                if frame:
+                    out_hists[name] = {
+                        "bounds": list(histograms[name].bounds),
+                        "slots": frame,
+                    }
+        return {
+            "span_s": span,
+            "slots": slots,
+            "now_epoch": int(_monotonic() / span),
+            "counters": out_counters,
+            "gauges": out_gauges,
+            "histograms": out_hists,
         }
 
     def prometheus_text(self) -> str:
@@ -1294,6 +1424,9 @@ class SnapshotExporter:
         serving = self._serving_status()
         if serving is not None:
             snap["serving"] = serving
+        cluster = self._cluster_status()
+        if cluster is not None:
+            snap["cluster"] = cluster
         if slo_state is not None:
             snap["slo"] = slo_state
         if final:
@@ -1311,6 +1444,12 @@ class SnapshotExporter:
             with open(tmp, "w") as f:
                 # sparkdl: allow(blocking-under-lock): see the open() above — one writer at a time by design
                 f.write(tel.metrics.prometheus_text())
+                # federated cluster series (whole-cluster merged view)
+                # append AFTER the local exposition: live scrapes of a
+                # cluster coordinator reflect every worker, and the
+                # text is empty — file byte-identical — off-path
+                # sparkdl: allow(blocking-under-lock): see the open() above — one writer at a time by design
+                f.write(self._cluster_prometheus_text())
             os.replace(tmp, self.prom_path)
 
     @staticmethod
@@ -1339,6 +1478,33 @@ class SnapshotExporter:
         if mod is None:
             return None
         return mod.exporter_status()
+
+    @staticmethod
+    def _cluster_status() -> Optional[Dict[str, Any]]:
+        """The federated cluster-metrics view of the live partition
+        router (windowed cluster-wide fold + ``workers_reporting``) —
+        same ``sys.modules`` stance as :meth:`_executor_status`: a
+        single-process run never imports the cluster plane, and the key
+        stays absent (snapshot lines byte-identical) unless a router
+        with metrics federation armed is live."""
+        import sys
+
+        mod = sys.modules.get("sparkdl_tpu.cluster.router")
+        if mod is None:
+            return None
+        return mod.exporter_status()
+
+    @staticmethod
+    def _cluster_prometheus_text() -> str:
+        """Federated Prometheus series of the live router, or ``""`` —
+        the ``.prom`` analogue of :meth:`_cluster_status` (same absent-
+        unless-armed stance, so off-path files stay byte-identical)."""
+        import sys
+
+        mod = sys.modules.get("sparkdl_tpu.cluster.router")
+        if mod is None:
+            return ""
+        return mod.exporter_prometheus_text()
 
     # -- the timeline that feeds RunReport -----------------------------------
 

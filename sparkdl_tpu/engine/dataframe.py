@@ -206,6 +206,17 @@ class EngineConfig:
     autoscale_queue_wait_high_s: float = 0.5
     autoscale_queue_wait_low_s: float = 0.05
     autoscale_rows_per_worker_high: int = 4096
+    # -- live metrics federation (cluster/aggregate.ClusterMetricsView,
+    # docs/OBSERVABILITY.md "Cluster metrics federation") ----------------------
+    # Cadence (seconds) at which each cluster worker ships a bounded
+    # windowed-metrics frame over its result pipe; the coordinator folds
+    # the frames into a live cluster-wide view (merged percentiles,
+    # summed rates) that the federated SLO watchdog, the autoscaler, and
+    # the exporter read mid-run. None (default) disables federation —
+    # no frames ship, no view exists, all artifacts byte-identical.
+    # NOT forced off inside workers: the worker loop reads this knob to
+    # drive its frame cadence.
+    cluster_federation_s: Optional[float] = None
     # -- cluster serving plane (sparkdl_tpu/serving/cluster.py,
     # docs/SERVING.md "Cluster serving") ---------------------------------------
     # Route ModelServer.predict through the cluster router: deployments
@@ -302,6 +313,7 @@ class EngineConfig:
                  cls.autoscale_queue_wait_high_s,
                  cls.autoscale_queue_wait_low_s,
                  cls.autoscale_rows_per_worker_high,
+                 cls.cluster_federation_s,
                  cls.serving_cluster, cls.serving_worker_residency_bytes,
                  cls.serving_failover_max,
                  (None if cls.executor_tenant_weights is None
@@ -429,6 +441,7 @@ class EngineConfig:
             raise ValueError(
                 "EngineConfig.autoscale_rows_per_worker_high must be "
                 f">= 1, got {cls.autoscale_rows_per_worker_high!r}")
+        positive("cluster_federation_s", cls.cluster_federation_s)
         if not isinstance(cls.serving_cluster, bool):
             raise ValueError(
                 "EngineConfig.serving_cluster must be a bool, got "

@@ -203,3 +203,43 @@ def test_shared_run_id_scopes_write_disjoint_files(tmp_path):
     with open(worker.exporter.snapshot_path) as f:
         (line,) = [json.loads(l) for l in f]     # the final flush
     assert line["run_id"] == "shared" and line["final"] is True
+
+
+def test_postmortem_bundle_dirs_leave_exporter_artifacts_undisturbed(
+        tmp_path):
+    """ISSUE 19 satellite: the flight recorder drops postmortem bundle
+    DIRECTORIES (``postmortem_<run_id>_<seq>/``, staged as ``.tmp`` then
+    renamed) into the SAME out_dir the exporter writes — mid-run. The
+    bundle namespace must never collide with any scope's file naming
+    (bare or process_scope-suffixed) and the dump must not perturb
+    snapshot ``seq`` monotonicity or the final flush."""
+    out = str(tmp_path)
+    with Telemetry("coord", out_dir=out, run_id="shared",
+                   export_interval_s=30.0) as tel:
+        tel.exporter.tick()
+        # the recorder's atomic-write idiom, landing between two ticks
+        staging = os.path.join(out, "postmortem_shared_0001.tmp")
+        os.mkdir(staging)
+        with open(os.path.join(staging, "breach.json"), "w") as f:
+            json.dump({"trigger": "slo_breach"}, f)
+        os.rename(staging, os.path.join(out, "postmortem_shared_0001"))
+        tel.exporter.tick()
+    lines = _lines(tel.exporter.snapshot_path)
+    seqs = [line["seq"] for line in lines]
+    assert len(seqs) >= 3  # tick, tick, final flush
+    assert seqs == sorted(set(seqs))  # strictly monotone past the dump
+    assert lines[-1]["final"] is True
+
+    # a worker scope sharing run_id AND out_dir (the cluster layout the
+    # recorder runs under) still writes all its suffixed artifacts
+    with Telemetry("worker", out_dir=out, run_id="shared",
+                   export_interval_s=30.0, process_scope="w0") as worker:
+        pass
+    names = set(os.listdir(out))
+    assert "postmortem_shared_0001" in names  # survived both closes
+    assert not any(n.startswith("postmortem_")
+                   for n in names - {"postmortem_shared_0001"})
+    for scope in (tel, worker):
+        for path in (scope.exporter.snapshot_path,
+                     scope.exporter.prom_path, scope.report_path):
+            assert os.path.isfile(path)  # files, never the bundle dir

@@ -1,0 +1,8 @@
+"""Seeded violation: typo'd metric key read out of a federated
+windowed-snapshot section (slo-metrics). The lookup silently returns
+None forever — the autoscaler here would simply never scale."""
+
+
+def cluster_queue_pressure(view):
+    snap = view.window_snapshot(30.0)
+    return snap["histograms"].get("sparkdl.executor.queue_wait_ss")

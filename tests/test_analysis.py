@@ -89,6 +89,7 @@ EXPECTED_FIXTURE_RULES = {
     "remote_span_name.py": {"span-names"},
     "health_bare_string.py": {"health-constants"},
     "slo_metric_typo.py": {"slo-metrics"},
+    "federated_frame_key.py": {"slo-metrics"},
     "state/durability.py": {"atomic-write"},
     "suppression_no_reason.py": {"blocking-under-lock",
                                  "suppression-hygiene"},
