@@ -122,6 +122,9 @@ SPAN_SERVING_SHADOW = "sparkdl.serving_shadow"  # shadow-lane replay of
 SPAN_SERVING_PREDICT = "sparkdl.serving_predict"  # worker-side execution
                                               # of one cluster-routed
                                               # predict (serving/cluster.py)
+SPAN_SERVING_WARMUP = "sparkdl.serving.warmup_s"  # AOT bucket-ladder
+                                              # warmup of one deployment
+                                              # (serving/registry.py)
 
 CANONICAL_SPAN_NAMES = frozenset({
     SPAN_RUN, SPAN_RUNNER_ATTEMPT, SPAN_FIT, SPAN_EPOCH,
@@ -130,6 +133,7 @@ CANONICAL_SPAN_NAMES = frozenset({
     SPAN_COMPILE, SPAN_COALESCED_LAUNCH, SPAN_DECODE_POOL,
     SPAN_MODEL_LOAD, SPAN_CLUSTER_DISPATCH, SPAN_CLUSTER_TASK,
     SPAN_DECODE_CHUNK, SPAN_SERVING_SHADOW, SPAN_SERVING_PREDICT,
+    SPAN_SERVING_WARMUP,
     # phase names (core/profiling.py constants + literal call sites)
     "sparkdl.decode", "sparkdl.stage", "sparkdl.stage_batch",
     "sparkdl.host_stage", "sparkdl.host_resize", "sparkdl.host_wait",
@@ -223,6 +227,13 @@ M_CLUSTER_REDISPATCH = "sparkdl.cluster.redispatch"    # counter
 M_CLUSTER_WORKERS = "sparkdl.cluster.workers"          # gauge (live,
                                                        # non-draining)
 M_CLUSTER_DRAIN_S = "sparkdl.cluster.drain_s"          # histogram
+# Pallas kernel autotune (core/kernels.py, docs/PERF.md "Fused kernels &
+# AOT warmup"): one histogram observation per shootout (build + numeric
+# check + timing of both candidates) and one adopted/rejected counter
+# bump per settled verdict.
+M_KERNEL_AUTOTUNE_S = "sparkdl.kernel.autotune_s"      # histogram
+M_KERNEL_ADOPTED = "sparkdl.kernel.adopted"            # counter
+M_KERNEL_REJECTED = "sparkdl.kernel.rejected"          # counter
 # Per-tenant fair queueing (core/executor.py, docs/RESILIENCE.md): each
 # tenant's queue-wait histogram gets a per-tenant NAME (metrics carry no
 # labels), declared dynamically as "sparkdl.executor.queue_wait_s.<tenant>"
@@ -270,6 +281,9 @@ CANONICAL_METRIC_KINDS: Dict[str, str] = {
     M_CLUSTER_REDISPATCH: "counter",
     M_CLUSTER_WORKERS: "gauge",
     M_CLUSTER_DRAIN_S: "histogram",
+    M_KERNEL_AUTOTUNE_S: "histogram",
+    M_KERNEL_ADOPTED: "counter",
+    M_KERNEL_REJECTED: "counter",
 }
 
 CANONICAL_METRIC_NAMES = frozenset(CANONICAL_METRIC_KINDS)

@@ -34,8 +34,11 @@ class InceptionV3(nn.Module):
         idx = [0]
 
         def cb(h, features, kh, kw, strides=(1, 1), padding="SAME"):
+            # kernel_family opts eligible 1x1 units into the fused pw1x1
+            # registry (core/kernels.py accept-if-faster autotune).
             m = ConvBN(features, (kh, kw), strides=strides, padding=padding,
-                       bn_scale=False, dtype=self.dtype, name=f"cb{idx[0]}")
+                       bn_scale=False, dtype=self.dtype, name=f"cb{idx[0]}",
+                       kernel_family="inception")
             idx[0] += 1
             return m(h, train)
 

@@ -102,7 +102,13 @@ class WorkerServingPlane:
         budget = EngineConfig.serving_worker_residency_bytes
         self._residency: Optional[ResidencyManager] = (
             ResidencyManager(budget) if budget else None)
-        self._registry = ModelRegistry(residency=self._residency)
+        # defer_warmup: a replica materializes (and AOT-warms, when
+        # serving_warmup is armed) on ITS cold load — first routed
+        # predict or srv_prepare — never at the deploy fan, which would
+        # load every version on every replica and turn a broken loader
+        # into a worker death instead of a prepare nack.
+        self._registry = ModelRegistry(residency=self._residency,
+                                       defer_warmup=True)
         self._deployed: Dict[Tuple[str, str], Any] = {}
         self._predicts = 0
         self._errors = 0

@@ -30,7 +30,70 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+import numpy as np
+
 from sparkdl_tpu.core import health, telemetry
+
+
+def _serving_warmup_armed() -> bool:
+    try:
+        from sparkdl_tpu.engine.dataframe import EngineConfig
+    except Exception:
+        return False
+    return bool(getattr(EngineConfig, "serving_warmup", False))
+
+
+def _serving_cluster_armed() -> bool:
+    try:
+        from sparkdl_tpu.engine.dataframe import EngineConfig
+    except Exception:
+        return False
+    return bool(getattr(EngineConfig, "serving_cluster", False))
+
+
+def warmup_deployment(model: Any, name: str, version: str,
+                      batch_size: int) -> None:
+    """AOT-compile ``model``'s FULL bucket ladder — one dummy batch per
+    rung, through the ``executor.execute`` choke point, so each rung's
+    exact padded variant (precision cast, donation, planner bucket)
+    compiles and its fused-kernel shootouts settle BEFORE the
+    deployment takes traffic (docs/PERF.md "Fused kernels & AOT
+    warmup").
+
+    Runs inside the deployment's loader — i.e. under the residency
+    single-flight on EVERY cold load: first deploy, reload after
+    eviction, and a cluster replica's ``srv_prepare`` (which therefore
+    acks prepared only after the ladder is warm; a warmup failure nacks
+    and rolls the cutover back). No-op unless
+    ``EngineConfig.serving_warmup``; models without a static input spec
+    (dict/dynamic specs) are skipped best-effort — their shapes aren't
+    knowable ahead of the first request."""
+    if not _serving_warmup_armed():
+        return
+    from sparkdl_tpu.core import batching, executor
+
+    spec = getattr(model, "input_spec", None)
+    elem = getattr(spec, "element_shape", None)
+    if elem is None or any(d is None for d in elem):
+        return
+    try:
+        eff_batch, multiple = model.bucket_params(int(batch_size))
+    except Exception:  # sparkdl: allow(broad-retry): best-effort skip —
+        # a model that cannot report bucket geometry stays lazy-compiled
+        return
+    planner = batching.default_planner(name, eff_batch, multiple)
+    rungs = (planner.ladder() if planner is not None
+             else batching._pow2_ladder(eff_batch, multiple, 8))
+    t0 = time.monotonic()
+    with telemetry.span(telemetry.SPAN_SERVING_WARMUP, model=name,
+                        version=version, rungs=repr(tuple(rungs))):
+        for rung in rungs:
+            batch = np.zeros((int(rung),) + tuple(elem),
+                             dtype=np.dtype(spec.dtype))
+            executor.execute(model, batch, batch_size=int(batch_size),
+                             coalesce=False, tenant=None)
+    health.record(health.WARMUP_COMPLETED, model=name, version=version,
+                  rungs=len(rungs), seconds=time.monotonic() - t0)
 
 
 class Deployment:
@@ -119,10 +182,17 @@ class ModelRegistry:
     plane; :func:`default_registry` is the process-wide one the ml/udf
     layers resolve string model names through)."""
 
-    def __init__(self, residency: Optional[Any] = None) -> None:
+    def __init__(self, residency: Optional[Any] = None, *,
+                 defer_warmup: bool = False) -> None:
         self._lock = threading.Lock()
         self._entries: Dict[str, _Entry] = {}
         self._residency = residency
+        # Cluster replicas set this: their boot config clears
+        # serving_cluster (a worker is not a coordinator), so without
+        # it the deploy fan would eagerly materialize EVERY version on
+        # EVERY replica — warmup must wait for the replica's own cold
+        # load (first routed predict or srv_prepare).
+        self._defer_warmup = bool(defer_warmup)
 
     # -- deployment lifecycle ------------------------------------------------
 
@@ -142,6 +212,29 @@ class ModelRegistry:
         if loader is None:
             def loader(m=model):
                 return m
+        # Every materialization path — Deployment.model(), the residency
+        # manager's single-flight acquire (incl. post-eviction reloads),
+        # and a cluster replica's srv_prepare — funnels through the
+        # loader, so wrapping it HERE is what makes warmup cover all of
+        # them. warmup_deployment itself no-ops when the knob is off.
+        # The marker keeps the wrap single-layer: the cluster
+        # coordinator ships the WRAPPED loader (cloudpickle preserves
+        # function attributes) and replicas re-deploy it through this
+        # same method — without the guard every replica cold load would
+        # pay (and health-record) the ladder twice.
+        raw_loader = loader
+
+        if getattr(raw_loader, "_sparkdl_warmup_wrap", False):
+            loader = raw_loader
+        else:
+            def loader(name=name, version=version,
+                       batch_size=batch_size, _load=raw_loader):
+                m = _load()
+                warmup_deployment(m, name, version, batch_size)
+                return m
+
+            loader._sparkdl_warmup_wrap = True
+
         if latency_target_ms is not None and latency_target_ms <= 0:
             raise ValueError(
                 f"latency_target_ms must be > 0 (or None), got "
@@ -165,6 +258,13 @@ class ModelRegistry:
             self._residency.register(name, version, loader, pinned=first)
         if activate and not first:
             self.cutover(name, version)
+        # Eagerly materialize (and therefore warm) at deploy time so the
+        # FIRST request pays zero compile — except on a cluster-serving
+        # coordinator, where replicas materialize worker-side during
+        # srv_prepare and a coordinator-local copy would be dead weight.
+        if _serving_warmup_armed() and not _serving_cluster_armed() \
+                and not self._defer_warmup:
+            dep.model()
         return dep
 
     def shadow(self, name: str, version: Optional[str],

@@ -1,0 +1,19 @@
+"""Fixture: raw Pallas usage outside core/kernels.py (kernel-gate).
+
+Both defects ship un-auditioned device code: a bare ``pallas_call``
+launch bypasses the accept-if-faster registry entirely, and a direct
+call to a ``core.kernels`` raw builder skips the adopted-verdict check
+(the route_* entry points are the only sanctioned way in).
+"""
+
+from jax.experimental import pallas as pl
+
+from sparkdl_tpu.core import kernels
+
+
+def launches_raw_pallas(kernel, x, out_shape):
+    return pl.pallas_call(kernel, out_shape=out_shape)(x)
+
+
+def calls_raw_builder(x, dw9, pw, scale, shift):
+    return kernels.sep2d(x, dw9, pw, scale, shift)

@@ -490,3 +490,97 @@ def test_rollback_is_cluster_atomic(rng):
     out = srv.predict("clf", row, deadline_ms=_DEADLINE_MS)
     assert out.version == "v1"
     assert reg.active_version("clf") == "v1"
+
+
+# ---------------------------------------------------------------------------
+# AOT bucket-ladder warmup across the cluster (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+
+def test_cluster_prepare_warms_ladder_on_every_replica(rng):
+    """srv_prepare materializes through the warmup-wrapped loader: by
+    the time a cutover commits, EVERY replica has paid the incoming
+    version's full bucket ladder — one warmup_completed per (replica,
+    version) cold load, federated into the merged cluster report."""
+    EngineConfig.serving_warmup = True  # BEFORE the router spawns:
+    # workers inherit EngineConfig at boot
+    _arm(2)
+    reg, srv = _stack()
+    m1 = _model(1.0)
+    reg.deploy("clf", "v1", model=m1, batch_size=8)
+    row = rng.normal(size=_ELEMENT).astype(np.float32)
+    # first predict: router spawns, ONE replica cold-loads (and warms) v1
+    out = srv.predict("clf", row, deadline_ms=_DEADLINE_MS)
+    assert out.version == "v1"
+
+    def v2_loader():
+        rng2 = np.random.default_rng(7)
+        w = jnp.asarray((rng2.normal(size=(_ELEMENT[0], _FEATURES)) * 2)
+                        .astype(np.float32))
+        return ModelFunction(lambda vs, x: jnp.tanh(x @ vs), w,
+                             TensorSpec((None,) + _ELEMENT, "float32"),
+                             name="served")
+
+    reg.deploy("clf", "v2", loader=v2_loader, batch_size=8)
+    srv.cutover("clf", "v2")  # two-phase: prepare warms BOTH replicas
+    out = srv.predict("clf", row, deadline_ms=_DEADLINE_MS)
+    assert out.version == "v2"
+    np.testing.assert_array_equal(np.asarray(out.output),
+                                  _reference(v2_loader(), row[None])[0])
+
+    router = _router()
+    router.close()
+    rep = router.cluster_report
+    per_worker = {
+        name: snap["health"]["counters"].get(health.WARMUP_COMPLETED, 0)
+        for name, snap in rep["workers"].items()}
+    assert len(per_worker) == 2
+    # v2 prepared (= warmed) on BOTH replicas before the commit; v1
+    # warmed only on the replica that served the first request
+    assert all(count >= 1 for count in per_worker.values()), per_worker
+    assert sum(per_worker.values()) == 3, per_worker
+    assert rep["health"]["counters"][health.WARMUP_COMPLETED] == 3
+    assert rep["health_consistent"]
+
+
+def test_cluster_failed_warmup_nacks_prepare_and_rolls_back(rng):
+    """The warmup gate has teeth: v2's loader succeeds on every
+    replica, but its ladder cannot execute — with serving_warmup armed
+    the cold load fails DURING warmup, the prepare nacks, and the
+    cutover rolls back with v1 still serving everywhere. Without the
+    gate this exact deployment would have prepared fine and detonated
+    on the first live request."""
+    EngineConfig.serving_warmup = True
+    _arm(2)
+    reg, srv = _stack()
+    m1 = _model(1.0)
+    reg.deploy("clf", "v1", model=m1, batch_size=8)
+
+    def dud_loader():
+        def _explode(vs, x):
+            raise RuntimeError("v2 cannot execute its ladder")
+
+        return ModelFunction(_explode, jnp.zeros((1,), jnp.float32),
+                             TensorSpec((None,) + _ELEMENT, "float32"),
+                             name="served")
+
+    reg.deploy("clf", "v2", loader=dud_loader, batch_size=8)
+    row = rng.normal(size=_ELEMENT).astype(np.float32)
+    srv.predict("clf", row, deadline_ms=_DEADLINE_MS)
+    with HealthMonitor("warm-prep") as mon:
+        with pytest.raises(serving_cluster.CutoverFailed,
+                           match="still serving everywhere"):
+            srv.cutover("clf", "v2")
+        assert mon.count(health.SERVING_PREPARE_FAILED) >= 1
+        assert mon.count(health.SERVING_CUTOVER) == 0
+    assert reg.active_version("clf") == "v1"
+    out = srv.predict("clf", row, deadline_ms=_DEADLINE_MS)
+    assert out.version == "v1"
+    np.testing.assert_array_equal(np.asarray(out.output),
+                                  _reference(m1, row[None])[0])
+    router = _router()
+    router.close()
+    section = router.cluster_report["serving"]["router"]
+    assert section["cutovers"] == 0
+    assert section["prepare_failures"] >= 1
+    assert section["deployments"]["clf"]["active"] == "v1"

@@ -19,6 +19,7 @@ from sparkdl_tpu.engine.dataframe import EngineConfig
 from sparkdl_tpu.serving import (
     ModelRegistry,
     ModelServer,
+    ResidencyManager,
     ServingOverloaded,
 )
 
@@ -451,3 +452,124 @@ def test_retire_model_drops_idle_states(rng):
         m, variants=m.device_variants())
     assert dropped >= 1
     assert not executor.status()["models"]
+
+
+# ---------------------------------------------------------------------------
+# AOT bucket-ladder warmup (ISSUE 20): serving_warmup knob
+# ---------------------------------------------------------------------------
+
+
+def test_warmup_armed_deploy_compiles_ladder_before_traffic(rng):
+    """Deploy with the knob armed: the full ladder compiles eagerly
+    (one WARMUP_COMPLETED, a sparkdl.serving.warmup_s span) and the
+    FIRST request then pays zero compile — no sparkdl.compile span."""
+    EngineConfig.serving_warmup = True
+    reg, srv = _serving_stack()
+    m = _model(1.0)
+    with Telemetry("warmup") as tel:
+        with HealthMonitor("warmup") as mon:
+            reg.deploy("clf", "v1", model=m, batch_size=8)
+        spans = tel.tracer.spans(name=telemetry.SPAN_SERVING_WARMUP)
+    assert len(spans) == 1
+    assert mon.count(health.WARMUP_COMPLETED) == 1
+    ev = mon.events(health.WARMUP_COMPLETED)[0]
+    assert ev["model"] == "clf" and ev["version"] == "v1"
+    assert ev["rungs"] >= 1
+
+    row = rng.normal(size=_ELEMENT).astype(np.float32)
+    with Telemetry("warmup") as tel:
+        got = srv.predict("clf", row)
+        assert tel.tracer.spans(name=telemetry.SPAN_COMPILE) == []
+    np.testing.assert_array_equal(got.output, _reference(m, row[None])[0])
+
+
+def test_warmup_off_deploy_stays_lazy(rng):
+    """Default (knob off): deploying a loader materializes NOTHING and
+    no warmup event fires — first traffic pays the cold start, exactly
+    the pre-knob behavior."""
+    reg, srv = _serving_stack()
+    calls = []
+
+    def loader():
+        calls.append(1)
+        return _model(1.0)
+
+    row = rng.normal(size=_ELEMENT).astype(np.float32)
+    with HealthMonitor("warmup") as mon:
+        reg.deploy("clf", "v1", loader=loader, batch_size=8)
+        assert calls == [], "deploy materialized a lazy loader"
+        srv.predict("clf", row)
+    assert calls == [1]
+    assert mon.count(health.WARMUP_COMPLETED) == 0
+
+
+def test_post_cutover_first_request_pays_zero_compile(rng):
+    """The dark v2 warms at deploy; after cutover its first live
+    request must hit only warmed programs."""
+    EngineConfig.serving_warmup = True
+    reg, srv = _serving_stack()
+    m1, m2 = _model(1.0), _model(-0.5)
+    row = rng.normal(size=_ELEMENT).astype(np.float32)
+    with HealthMonitor("warmup") as mon:
+        reg.deploy("clf", "v1", model=m1, batch_size=8)
+        srv.predict("clf", row)
+        reg.deploy("clf", "v2", model=m2, batch_size=8)  # dark + warmed
+    assert mon.count(health.WARMUP_COMPLETED) == 2
+    reg.cutover("clf", "v2")
+    with Telemetry("warmup") as tel:
+        got = srv.predict("clf", row)
+        assert tel.tracer.spans(name=telemetry.SPAN_COMPILE) == []
+    assert got.version == "v2"
+    np.testing.assert_array_equal(got.output,
+                                  _reference(m2, row[None])[0])
+
+
+def test_eviction_reload_rewarms_ladder(rng):
+    """Warmup wraps the LOADER, so a post-eviction residency reload
+    pays the ladder again before taking traffic."""
+    EngineConfig.serving_warmup = True
+    res = ResidencyManager(budget_bytes=10 * 1024)
+    reg = ModelRegistry(residency=res)
+    srv = ModelServer(reg)
+    row = rng.normal(size=_ELEMENT).astype(np.float32)
+    with HealthMonitor("warmup") as mon:
+        reg.deploy("clf", "v1", loader=lambda: _model(1.0), batch_size=8)
+        assert mon.count(health.WARMUP_COMPLETED) == 1
+        res.pin("clf", "v1", pinned=False)
+        assert res.evict("clf", "v1")
+        srv.predict("clf", row)  # cold reload -> the ladder re-warms
+    assert mon.count(health.WARMUP_COMPLETED) == 2
+
+
+def test_warmup_skips_models_without_static_shape(rng):
+    """A dynamic element shape has no knowable ladder: warmup skips
+    best-effort, deploy and serving still work."""
+    EngineConfig.serving_warmup = True
+    reg, srv = _serving_stack()
+    base = _model(1.0)
+    m = ModelFunction(lambda vs, x: jnp.tanh(x @ vs), base.variables,
+                      TensorSpec((None, None), "float32"), name="dyn")
+    row = rng.normal(size=_ELEMENT).astype(np.float32)
+    with HealthMonitor("warmup") as mon:
+        reg.deploy("clf", "v1", model=m, batch_size=8)
+        got = srv.predict("clf", row)
+    assert mon.count(health.WARMUP_COMPLETED) == 0
+    np.testing.assert_array_equal(got.output,
+                                  _reference(base, row[None])[0])
+
+
+def test_warmup_failure_surfaces_at_deploy(rng):
+    """A model that cannot execute its ladder fails the eager deploy
+    loudly (cluster-side this same propagation is what nacks
+    srv_prepare and rolls a cutover back)."""
+    EngineConfig.serving_warmup = True
+    reg, _ = _serving_stack()
+
+    def _explode(vs, x):
+        raise RuntimeError("bad weights")
+
+    bad = ModelFunction(_explode, jnp.zeros((1,), jnp.float32),
+                        TensorSpec((None,) + _ELEMENT, "float32"),
+                        name="bad")
+    with pytest.raises(RuntimeError, match="bad weights"):
+        reg.deploy("clf", "v1", model=bad, batch_size=8)
